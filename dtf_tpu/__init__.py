@@ -1,0 +1,24 @@
+"""dtf_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA/pjit/Pallas re-design of the capability surface of the
+reference repo ``zjj2wry/distributed-tensorflow`` (a TF1 parameter-server /
+worker training harness; see SURVEY.md for the full structural analysis).
+
+The reference's ps/worker roles collapse into a single pjit'd train step over
+a TPU device mesh:
+
+- variable placement (``tf.device('/job:ps')`` + ``replica_device_setter``)
+  → GSPMD ``NamedSharding`` over a named mesh       → :mod:`dtf_tpu.core.mesh`,
+    :mod:`dtf_tpu.core.sharding`
+- gradient aggregation (``SyncReplicasOptimizer``) → mean-gradients via XLA
+  all-reduce over ICI                               → :mod:`dtf_tpu.core.train`
+- ``MonitoredTrainingSession`` hooks (checkpoint / summary / recovery)
+  → Orbax + metric writers + a hook-driven loop     → :mod:`dtf_tpu.loop`,
+    :mod:`dtf_tpu.checkpoint`, :mod:`dtf_tpu.metrics`
+- ``ClusterSpec`` / ``tf.train.Server`` bootstrap   → ``jax.distributed`` +
+  mesh construction                                 → :mod:`dtf_tpu.core.dist`
+"""
+
+__version__ = "0.1.0"
+
+from dtf_tpu.core.mesh import MeshConfig, make_mesh, AXIS_DATA, AXIS_SEQ, AXIS_MODEL

@@ -1,0 +1,1 @@
+"""Core runtime: mesh construction, collectives, sharding rules, train step."""

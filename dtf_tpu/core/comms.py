@@ -1,0 +1,122 @@
+"""Collective communication layer — the TPU-native successor of the reference's
+gRPC rendezvous + NCCL backends.
+
+Reference capability replaced (SURVEY.md §2d, §3.1): every PS↔worker variable
+read and gradient push in the reference is a remote send/recv through TF's C++
+rendezvous (``base_rendezvous_mgr.h``), and its collective strategy rides NCCL
+ring all-reduce (``cross_device_ops.py`` ``NcclAllReduce``). Here the only
+communication primitives are mesh-axis collectives, lowered by XLA onto ICI
+(intra-slice) / DCN (inter-slice). There is deliberately no transport code:
+picking the wire, ring schedule, and overlap is the compiler's job.
+
+Two usage contexts:
+
+- inside ``shard_map`` (per-shard code with named axes): the ``p*`` wrappers.
+- outside (global arrays under ``jit``): sharding-annotated ops; XLA inserts
+  the equivalent collectives automatically. Helpers here build the shardings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+
+
+# ---------------------------------------------------------------------------
+# Named-axis collectives (for use inside shard_map / custom SPMD code).
+# ---------------------------------------------------------------------------
+
+def psum(x: PyTree, axis: str | Sequence[str]) -> PyTree:
+    """Sum over a mesh axis. Successor of the PS gradient push + NCCL ring."""
+    return jax.lax.psum(x, axis)
+
+
+def pmean(x: PyTree, axis: str | Sequence[str]) -> PyTree:
+    """Mean over a mesh axis — the exact ``SyncReplicasOptimizer`` semantics
+    (mean of ``replicas_to_aggregate`` gradients; SURVEY.md §3.3)."""
+    return jax.lax.pmean(x, axis)
+
+
+def psum_scatter(x: jax.Array, axis: str, *, scatter_dimension: int = 0,
+                 tiled: bool = True) -> jax.Array:
+    """Reduce-scatter — the building block of ZeRO-1 weight-update sharding."""
+    return jax.lax.psum_scatter(
+        x, axis, scatter_dimension=scatter_dimension, tiled=tiled)
+
+
+def all_gather(x: jax.Array, axis: str, *, gather_dimension: int = 0,
+               tiled: bool = True) -> jax.Array:
+    return jax.lax.all_gather(
+        x, axis, axis=gather_dimension, tiled=tiled)
+
+
+def axis_index(axis: str) -> jax.Array:
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)
+
+
+def ring_pass(x: PyTree, axis: str, *, shift: int = 1) -> PyTree:
+    """Pass each shard to its ring neighbor along ``axis`` (ppermute).
+
+    The primitive under ring attention / ring all-reduce: neighbor exchange
+    rides a single ICI hop per step.
+    """
+    n = jax.lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.tree.map(lambda t: jax.lax.ppermute(t, axis, perm), x)
+
+
+# ---------------------------------------------------------------------------
+# Global-array helpers (outside shard_map).
+# ---------------------------------------------------------------------------
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, *, batch_dim: int = 0,
+                   axis: str | tuple[str, ...] = "data") -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis."""
+    spec = [None] * (batch_dim + 1)
+    spec[batch_dim] = axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_batch(batch: PyTree, mesh: Mesh, *, batch_dim: int = 0) -> PyTree:
+    """Place a host-global batch onto the mesh, split over ``data``.
+
+    Single-process path. For multi-host (each process holding its slice of
+    the global batch) use :func:`host_local_to_global`.
+    """
+    sh = batch_sharding(mesh, batch_dim=batch_dim)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+
+def host_local_to_global(local_batch: PyTree, mesh: Mesh,
+                         *, batch_dim: int = 0,
+                         axis: str | tuple[str, ...] = "data") -> PyTree:
+    """Assemble per-process local batches into one global sharded array.
+
+    Successor of the reference's per-worker feed_dict: each worker fed its own
+    batch into its own graph replica; here each process contributes its slice
+    of a single global array (``jax.make_array_from_process_local_data``).
+    """
+    sh = batch_sharding(mesh, batch_dim=batch_dim, axis=axis)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sh, np.asarray(x)),
+        local_batch)
+
+
+def global_norm(tree: PyTree) -> jax.Array:
+    """L2 norm over a pytree (for grad-norm logging/clipping)."""
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))

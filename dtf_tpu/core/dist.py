@@ -1,0 +1,116 @@
+"""Multi-host bootstrap — the TPU-native successor of ClusterSpec/Server/join.
+
+Reference capability replaced (SURVEY.md §1 L5/L6, §2b N1/N5): the reference
+launches N processes with ``--ps_hosts/--worker_hosts/--job_name/--task_index``
+flags, builds a ``tf.train.ClusterSpec`` and an in-process gRPC
+``tf.train.Server`` in each, and PS processes block in ``server.join()``.
+
+Here the same flags are accepted and *collapsed*: there is no PS role (its
+state becomes GSPMD-sharded arrays), every former worker becomes one JAX
+process, and bootstrap is ``jax.distributed.initialize`` — which stands up the
+same TSL coordination service the modern TF stack uses for health/barriers
+(SURVEY.md §2b N5: ``coordination_service.h``). Chief ≡ process 0 (the
+reference's ``is_chief = (task_index == 0)``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Sequence
+
+import jax
+
+log = logging.getLogger("dtf_tpu")
+
+
+@dataclasses.dataclass(frozen=True)
+class ClusterInfo:
+    """Resolved cluster identity after collapsing ps/worker flags."""
+
+    num_processes: int
+    process_id: int
+    coordinator_address: str | None
+    is_chief: bool
+    #: True for --job_name=ps: this process has no role on the TPU backend
+    #: (the ``server.join()`` successor is "exit 0 immediately").
+    should_exit: bool = False
+    notes: tuple[str, ...] = ()
+
+
+def collapse_cluster_flags(
+    ps_hosts: Sequence[str] = (),
+    worker_hosts: Sequence[str] = (),
+    job_name: str = "worker",
+    task_index: int = 0,
+) -> ClusterInfo:
+    """Map the reference's cluster flags onto the SPMD world.
+
+    - workers → JAX processes (world size = len(worker_hosts), min 1)
+    - ps hosts → warned and dropped (parameters live sharded on device)
+    - job_name=ps → this process has no role; caller should exit 0 (the
+      ``server.join()`` successor is "don't start")
+    - chief = task_index 0 (identical to the reference)
+    """
+    notes = []
+    worker_hosts = [h for h in worker_hosts if h]
+    ps_hosts = [h for h in ps_hosts if h]
+    if ps_hosts:
+        notes.append(
+            f"--ps_hosts={','.join(ps_hosts)} ignored: parameter servers do "
+            "not exist on the TPU backend; parameters are GSPMD-sharded "
+            "across the device mesh.")
+    num = max(len(worker_hosts), 1)
+    if job_name == "ps":
+        notes.append(
+            "--job_name=ps maps to no role on the TPU backend (variables are "
+            "mesh-sharded); this process should exit immediately.")
+        n_ps = max(len(ps_hosts), 1)
+        if not (0 <= task_index < n_ps):
+            raise ValueError(
+                f"--task_index={task_index} out of range for {n_ps} ps tasks")
+        for n in notes:
+            log.warning(n)
+        return ClusterInfo(
+            num_processes=num, process_id=0, coordinator_address=None,
+            is_chief=False, should_exit=True, notes=tuple(notes))
+    if not (0 <= task_index < num):
+        raise ValueError(
+            f"--task_index={task_index} out of range for {num} workers")
+    # The reference's chief (worker 0) did init/checkpoint; process 0 keeps
+    # those duties (Orbax saves, summary writes).
+    coordinator = worker_hosts[0] if len(worker_hosts) > 1 else None
+    for n in notes:
+        log.warning(n)
+    return ClusterInfo(
+        num_processes=num,
+        process_id=task_index,
+        coordinator_address=coordinator,
+        is_chief=(task_index == 0),
+        notes=tuple(notes),
+    )
+
+
+def initialize(info: ClusterInfo) -> None:
+    """Start the distributed runtime if this is a multi-process job.
+
+    ``jax.distributed.initialize`` boots the TSL coordination service on the
+    chief and connects every process to it — liveness, barrier, and device
+    enumeration; afterwards ``jax.devices()`` is cluster-global.
+    """
+    if info.num_processes <= 1 or info.should_exit:
+        return
+    # Must not touch jax.devices()/process_count() here: any backend init
+    # before jax.distributed.initialize() makes it raise.
+    from jax._src import distributed as _jdist
+    if _jdist.global_state.client is not None:  # already initialized
+        return
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator_address,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+
+
+def is_chief() -> bool:
+    return jax.process_index() == 0

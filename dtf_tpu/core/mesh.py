@@ -1,0 +1,92 @@
+"""Device-mesh construction — the TPU-native successor of ``tf.train.ClusterSpec``.
+
+Reference capability replaced (SURVEY.md §1 L5, §2b N1/N2): the reference
+builds a cluster from ``--ps_hosts``/``--worker_hosts`` flags via
+``tf.train.ClusterSpec`` + ``tf.train.Server`` (TF's
+``python/training/server_lib.py``), then pins variables to PS tasks. Here the
+cluster is a single logical device mesh; "placement" is a ``NamedSharding``
+over the mesh axes, and XLA's GSPMD partitioner does what the TF master's
+graph partitioner did.
+
+Axis convention (sizes of 1 are allowed and common):
+
+- ``data``  — data parallelism. Batches are sharded over it; gradients are
+  mean-reduced over it (the ``SyncReplicasOptimizer`` semantics); ZeRO-1
+  shards optimizer state over it.
+- ``seq``   — sequence/context parallelism (ring attention over ICI neighbors).
+- ``model`` — tensor parallelism (Megatron-style column/row sharding) and
+  row-sharded embedding tables (the PS-sharded-embedding successor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_SEQ = "seq"
+AXIS_MODEL = "model"
+#: Canonical mesh axis order. data is the slowest-varying axis so that the
+#: model/seq axes land on adjacent devices (best ICI locality for the
+#: high-traffic TP/SP collectives; DP all-reduce is once per step and can
+#: span the longer mesh dimension).
+AXES = (AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``data=-1`` means "all remaining devices"."""
+
+    data: int = -1
+    seq: int = 1
+    model: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int]:
+        seq, model = self.seq, self.model
+        if seq <= 0 or model <= 0:
+            raise ValueError(f"seq/model axis sizes must be positive, got {self}")
+        data = self.data
+        if data <= 0:
+            if n_devices % (seq * model):
+                raise ValueError(
+                    f"{n_devices} devices not divisible by seq*model={seq * model}"
+                )
+            data = n_devices // (seq * model)
+        if data * seq * model != n_devices:
+            raise ValueError(
+                f"mesh {data}x{seq}x{model} != {n_devices} devices"
+            )
+        return (data, seq, model)
+
+
+def make_mesh(
+    config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None
+) -> Mesh:
+    """Build the global device mesh.
+
+    This is the whole cluster-bootstrap story: where the reference spun up one
+    gRPC server per process and partitioned a graph across them, we enumerate
+    devices (already cluster-global after ``jax.distributed.initialize``) and
+    arrange them into a named mesh.
+    """
+    config = config or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = config.resolve(len(devices))
+    return jax.make_mesh(shape, AXES, devices=devices)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    """A 1x1x1 mesh for single-chip runs (the local dev/bench path)."""
+    device = device or jax.devices()[0]
+    return jax.make_mesh((1, 1, 1), AXES, devices=[device])
+
+
+def mesh_summary(mesh: Mesh) -> str:
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = math.prod(mesh.devices.shape)
+    plat = mesh.devices.flat[0].platform
+    return f"mesh[{plat}x{n}] " + " ".join(f"{k}={v}" for k, v in sizes.items())

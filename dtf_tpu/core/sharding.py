@@ -1,0 +1,124 @@
+"""Parameter-placement rules — the TPU-native successor of
+``tf.train.replica_device_setter``.
+
+Reference capability replaced (SURVEY.md §1 L4): the reference pins every
+variable to a PS task with ``tf.device(replica_device_setter(...))``, which
+round-robins variables across ``/job:ps`` (TF ``device_setter.py``
+``_RoundRobinStrategy``). Here placement is declarative: a small rulebook of
+``(path regex → PartitionSpec)`` maps each parameter to mesh axes, and GSPMD
+materializes the layout. Round-robin across PS hosts becomes row/column
+sharding across the mesh.
+
+Also implements ZeRO-1 optimizer-state sharding (BASELINE config 4): the
+optimizer state is sharded over the ``data`` axis (per "Automatic
+Cross-Replica Sharding of Weight Update", PAPERS.md) — under GSPMD this turns
+the weight update into reduce-scatter + sharded-update + all-gather
+automatically.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PyTree = Any
+#: A rule: (regex matched against "/"-joined param path, PartitionSpec).
+Rule = tuple[str, P]
+
+REPLICATED = P()
+
+
+def path_str(path) -> str:
+    """'/'-joined key path for a pytree leaf (flax param dicts → 'layer/kernel')."""
+    parts = []
+    for k in path:
+        if hasattr(k, "key"):
+            parts.append(str(k.key))
+        elif hasattr(k, "idx"):
+            parts.append(str(k.idx))
+        elif hasattr(k, "name"):
+            parts.append(str(k.name))
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def spec_for(path: str, rules: Sequence[Rule], default: P = REPLICATED) -> P:
+    """First-match-wins lookup of a PartitionSpec for a param path."""
+    for pattern, spec in rules:
+        if re.search(pattern, path):
+            return spec
+    return default
+
+
+def tree_specs(tree: PyTree, rules: Sequence[Rule],
+               default: P = REPLICATED) -> PyTree:
+    """PartitionSpec pytree for ``tree`` (params) under ``rules``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: spec_for(path_str(path), rules, default), tree)
+
+
+def tree_shardings(tree: PyTree, mesh: Mesh, rules: Sequence[Rule] = (),
+                   default: P = REPLICATED) -> PyTree:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s),
+                        tree_specs(tree, rules, default))
+
+
+def shard_tree(tree: PyTree, mesh: Mesh, rules: Sequence[Rule] = (),
+               default: P = REPLICATED) -> PyTree:
+    """device_put a pytree according to rules (the replica_device_setter moment)."""
+    return jax.tree.map(jax.device_put, tree,
+                        tree_shardings(tree, mesh, rules, default))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1: optimizer-state sharding over the data axis.
+# ---------------------------------------------------------------------------
+
+def _zero1_leaf_spec(param_spec: P, shape: tuple[int, ...], data_size: int,
+                     axis: str) -> P:
+    """Extend a param's spec by sharding its first free divisible dim over
+    ``axis``. Scalars / indivisible leaves stay at the param's own spec."""
+    spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    used = {a for s in spec for a in ((s,) if isinstance(s, str) else (s or ()))}
+    if data_size > 1 and axis not in used:
+        for i, (s, dim) in enumerate(zip(spec, shape)):
+            if s is None and dim % data_size == 0 and dim >= data_size:
+                spec[i] = axis
+                break
+    return P(*spec)
+
+
+def zero1_opt_specs(tx: optax.GradientTransformation, params: PyTree,
+                    param_specs: PyTree, mesh: Mesh,
+                    axis: str = "data") -> PyTree:
+    """PartitionSpec tree for ``tx.init(params)`` with ZeRO-1 sharding.
+
+    Param-shaped leaves (adam mu/nu, momentum, ...) get the param's spec plus
+    a ``data``-axis shard on their first free dimension; non-param leaves
+    (step counts) are replicated. This is the successor of the reference's
+    PS-resident optimizer slots: state lives sharded instead of remote.
+    """
+    data_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    abstract_state = jax.eval_shape(tx.init, params)
+
+    def leaf_spec(state_leaf, spec):
+        return _zero1_leaf_spec(spec, state_leaf.shape, data_size, axis)
+
+    return optax.tree_map_params(
+        tx, leaf_spec, abstract_state, param_specs,
+        transform_non_params=lambda _: REPLICATED)
+
+
+def opt_specs_like_params(tx: optax.GradientTransformation, params: PyTree,
+                          param_specs: PyTree) -> PyTree:
+    """Optimizer-state specs mirroring the params' specs (no ZeRO)."""
+    abstract_state = jax.eval_shape(tx.init, params)
+    return optax.tree_map_params(
+        tx, lambda _leaf, spec: spec, abstract_state, param_specs,
+        transform_non_params=lambda _: REPLICATED)

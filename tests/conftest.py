@@ -1,0 +1,62 @@
+"""Test bootstrap: force an 8-device virtual CPU mesh.
+
+The environment's sitecustomize registers the `axon` TPU PJRT plugin at
+interpreter start whenever PALLAS_AXON_POOL_IPS is set, which pulls in the
+single real TPU chip. Distributed-semantics tests need 8 simulated devices on
+CPU (the moral equivalent of TF's create_in_process_cluster; SURVEY.md §4),
+so if the current process came up with the wrong platform config we re-exec
+pytest once with a clean environment. This keeps `python -m pytest tests/`
+working from any shell without wrapper scripts.
+"""
+
+import os
+import sys
+
+_WANT_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _env_ok() -> bool:
+    return (
+        not os.environ.get("PALLAS_AXON_POOL_IPS")
+        and os.environ.get("JAX_PLATFORMS") == "cpu"
+        and _WANT_FLAG in os.environ.get("XLA_FLAGS", "")
+    )
+
+
+if not _env_ok() and os.environ.get("_DTF_TPU_TEST_REEXEC") != "1":
+    env = dict(os.environ)
+    env["_DTF_TPU_TEST_REEXEC"] = "1"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
+
+# Repo root on sys.path so `import dtf_tpu` works without installation.
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    assert len(jax.devices()) == 8, "conftest failed to force 8 CPU devices"
+    return make_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_2x2x2():
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    return make_mesh(MeshConfig(data=2, seq=2, model=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_4x2():
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    return make_mesh(MeshConfig(data=4, seq=1, model=2))

@@ -1,0 +1,68 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from dtf_tpu.core import comms
+
+
+def shmap(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+def test_pmean_matches_sync_replicas_semantics(mesh8):
+    # SyncReplicasOptimizer: gradient = mean over replicas (SURVEY.md §3.3).
+    per_replica = jnp.arange(8.0).reshape(8, 1)
+    out = shmap(lambda g: comms.pmean(g, "data"), mesh8,
+                P("data", None), P(None, None))(per_replica)
+    np.testing.assert_allclose(np.asarray(out), np.full((1, 1), 3.5))
+
+
+def test_psum_scatter_all_gather_roundtrip(mesh8):
+    x = jnp.arange(64.0).reshape(8, 8)
+
+    def fn(x):
+        # x: (1, 8) shard. reduce-scatter then all-gather == psum.
+        s = comms.psum_scatter(x[0], "data")  # (1,)
+        return comms.all_gather(s, "data")[None]
+
+    out = shmap(fn, mesh8, P("data", None), P("data", None))(x)
+    expected = np.tile(np.asarray(x).sum(0, keepdims=True), (8, 1))
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_ring_pass(mesh8):
+    x = jnp.arange(8.0).reshape(8, 1)
+    out = shmap(lambda v: comms.ring_pass(v, "data"), mesh8,
+                P("data", None), P("data", None))(x)
+    # shard i receives from i-1 (shift=1 sends i -> i+1).
+    np.testing.assert_allclose(np.asarray(out)[:, 0],
+                               np.roll(np.arange(8.0), 1))
+
+
+def test_axis_index_size(mesh_2x2x2):
+    def fn():
+        return (comms.axis_index("model") + 10 * comms.axis_index("seq")
+                + 100 * comms.axis_index("data"))[None]
+
+    out = shmap(fn, mesh_2x2x2, (), P(("data", "seq", "model")))()
+    assert sorted(np.asarray(out).tolist()) == [0, 1, 10, 11, 100, 101, 110, 111]
+
+
+def test_shard_batch_places_on_data_axis(mesh8):
+    batch = {"x": np.ones((16, 4), np.float32), "y": np.zeros((16,), np.int32)}
+    global_batch = comms.shard_batch(batch, mesh8)
+    assert global_batch["x"].sharding.spec == P("data")
+    assert global_batch["x"].addressable_shards[0].data.shape == (2, 4)
+
+
+def test_host_local_to_global_single_process(mesh8):
+    batch = {"x": np.arange(32, dtype=np.float32).reshape(16, 2)}
+    out = comms.host_local_to_global(batch, mesh8)
+    np.testing.assert_allclose(np.asarray(out["x"]), batch["x"])
+    assert out["x"].sharding.spec == P("data")
+
+
+def test_global_norm():
+    tree = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    np.testing.assert_allclose(float(comms.global_norm(tree)), 5.0)

@@ -1,0 +1,48 @@
+import pytest
+
+from dtf_tpu.core.dist import collapse_cluster_flags
+
+
+def test_single_process_default():
+    info = collapse_cluster_flags()
+    assert info.num_processes == 1
+    assert info.is_chief
+    assert not info.should_exit
+    assert info.coordinator_address is None
+
+
+def test_worker_collapse():
+    info = collapse_cluster_flags(
+        ps_hosts=["p0:2222"], worker_hosts=["w0:2223", "w1:2224"],
+        job_name="worker", task_index=1)
+    assert info.num_processes == 2
+    assert info.process_id == 1
+    assert not info.is_chief
+    assert info.coordinator_address == "w0:2223"
+    assert any("ps_hosts" in n for n in info.notes)
+
+
+def test_chief_is_task_zero():
+    info = collapse_cluster_flags(worker_hosts=["w0", "w1"], task_index=0)
+    assert info.is_chief
+
+
+def test_ps_role_exits_cleanly():
+    # Reference ps tasks index over ps_hosts, not workers; ps task 1 with a
+    # single worker must not raise, and must never be chief.
+    info = collapse_cluster_flags(
+        ps_hosts=["p0", "p1"], worker_hosts=["w0"], job_name="ps",
+        task_index=1)
+    assert info.should_exit
+    assert not info.is_chief
+
+
+def test_ps_task_index_validated_against_ps_hosts():
+    with pytest.raises(ValueError, match="ps tasks"):
+        collapse_cluster_flags(ps_hosts=["p0"], worker_hosts=["w0"],
+                               job_name="ps", task_index=5)
+
+
+def test_worker_task_index_out_of_range():
+    with pytest.raises(ValueError, match="workers"):
+        collapse_cluster_flags(worker_hosts=["w0"], task_index=3)

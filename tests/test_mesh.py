@@ -1,0 +1,35 @@
+import jax
+import pytest
+
+from dtf_tpu.core.mesh import AXES, MeshConfig, make_mesh, mesh_summary, single_device_mesh
+
+
+def test_default_mesh_all_data():
+    mesh = make_mesh()
+    assert mesh.axis_names == AXES
+    assert mesh.devices.shape == (8, 1, 1)
+
+
+def test_resolve_infers_data():
+    assert MeshConfig(seq=2, model=2).resolve(8) == (2, 2, 2)
+    assert MeshConfig(data=4, model=2).resolve(8) == (4, 1, 2)
+
+
+def test_resolve_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        MeshConfig(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(seq=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(model=0).resolve(8)
+
+
+def test_mesh_3d(mesh_2x2x2):
+    assert mesh_2x2x2.devices.shape == (2, 2, 2)
+    assert "data=2" in mesh_summary(mesh_2x2x2)
+
+
+def test_single_device_mesh():
+    mesh = single_device_mesh()
+    assert mesh.devices.shape == (1, 1, 1)
+    assert mesh.devices.flat[0] == jax.devices()[0]
