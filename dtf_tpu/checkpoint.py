@@ -1,0 +1,82 @@
+"""Checkpoint/resume — successor of Saver + CheckpointSaverHook + restore.
+
+Reference capability replaced (SURVEY.md §5.4): ``tf.train.Saver`` driven by
+``CheckpointSaverHook`` on the chief (save every N steps/secs to ``--logdir``),
+with automatic restore-if-exists in ``ChiefSessionCreator``. There, variables
+lived on parameter servers, so the chief pulled every tensor over gRPC to
+write one file. Here state is GSPMD-sharded and Orbax writes each shard from
+the process that owns it, asynchronously — no gather, no traffic spike.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+PyTree = Any
+
+
+class Checkpointer:
+    """Thin Orbax CheckpointManager wrapper for TrainState pytrees."""
+
+    def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        directory = os.fspath(directory)
+        os.makedirs(directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                enable_async_checkpointing=async_save,
+            ),
+        )
+
+    @property
+    def directory(self) -> str:
+        return os.fspath(self._mgr.directory)
+
+    def save(self, step: int, state: PyTree, *, force: bool = False) -> bool:
+        """Async sharded save. Returns True if a save was actually queued."""
+        step = int(step)
+        if step in self._mgr.all_steps():
+            return False
+        return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                              force=force)
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, target: PyTree, step: int | None = None) -> PyTree:
+        """Restore into the shardings of ``target``.
+
+        ``target`` may be a concrete sharded TrainState (its leaves' shardings
+        are reused — the restore-if-exists moment of ``ChiefSessionCreator``)
+        or a pytree of ShapeDtypeStruct with shardings.
+        """
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                           sharding=x.sharding)
+            if isinstance(x, jax.Array) else x, target)
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:
+        """(state, restored_step) — state unchanged if nothing on disk."""
+        step = self._mgr.latest_step()
+        if step is None:
+            return target, None
+        return self.restore(target, step), step
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -1,0 +1,1 @@
+"""Flag-compatible launch layer (the reference's CLI contract)."""

@@ -1,0 +1,51 @@
+"""The reference's flag surface, kept launch-compatible (SURVEY.md §5.6).
+
+The reference defines ``tf.app.flags`` globals (``ps_hosts``, ``worker_hosts``,
+``job_name``, ``task_index``, ``issync``, data/lr/batch/steps) and runs via
+``tf.app.run``. The contract here (BASELINE north_star): "the existing run
+scripts launch unchanged with ``--backend=tpu``". Same names, same comma
+separated host lists; on the TPU backend the ps/worker flags collapse into
+mesh + process identity (:func:`dtf_tpu.core.dist.collapse_cluster_flags`).
+"""
+
+from __future__ import annotations
+
+from absl import flags
+
+FLAGS = flags.FLAGS
+
+
+def define_cluster_flags():
+    flags.DEFINE_string("ps_hosts", "", "comma-separated ps host:port list "
+                        "(accepted for compatibility; collapsed on tpu)")
+    flags.DEFINE_string("worker_hosts", "", "comma-separated worker host:port "
+                        "list; becomes the process world on tpu")
+    flags.DEFINE_string("job_name", "worker", "'ps' or 'worker'; ps exits "
+                        "immediately on the tpu backend")
+    flags.DEFINE_integer("task_index", 0, "index within the job")
+    flags.DEFINE_boolean("issync", True, "sync gradient aggregation. The tpu "
+                         "backend is always synchronous; issync=False warns "
+                         "(async PS is an anti-pattern on TPU) and proceeds "
+                         "synchronously")
+    flags.DEFINE_string("backend", "tpu", "tpu | cpu (cpu = simulated mesh "
+                        "for local testing)")
+
+
+def define_mesh_flags():
+    flags.DEFINE_integer("mesh_data", -1, "data-parallel axis size (-1: all "
+                         "remaining devices)")
+    flags.DEFINE_integer("mesh_seq", 1, "sequence/context-parallel axis size")
+    flags.DEFINE_integer("mesh_model", 1, "tensor-parallel axis size")
+
+
+def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000):
+    flags.DEFINE_string("data_dir", "", "dataset directory (empty: synthetic)")
+    flags.DEFINE_string("logdir", "/tmp/dtf_tpu_logs", "checkpoint/summary dir")
+    flags.DEFINE_integer("batch_size", batch_size, "GLOBAL batch size (the "
+                         "reference's per-worker batch × num workers)")
+    flags.DEFINE_float("learning_rate", learning_rate, "learning rate")
+    flags.DEFINE_integer("train_steps", train_steps, "stop at this global step")
+    flags.DEFINE_integer("checkpoint_every", 200, "steps between saves")
+    flags.DEFINE_integer("log_every", 10, "steps between metric logs")
+    flags.DEFINE_integer("grad_accum", 1, "gradient-accumulation microbatches")
+    flags.DEFINE_integer("seed", 0, "PRNG seed")

@@ -1,0 +1,53 @@
+"""Launch glue: flags → cluster collapse → mesh → trainer pieces.
+
+This is where the reference's L5/L6 (flag parse → ClusterSpec → Server →
+ps join / worker build) becomes: parse the same flags, collapse roles,
+``jax.distributed`` bootstrap when multi-process, build the mesh, hand the
+script a ready (mesh, cluster_info) pair. See SURVEY.md §7 "Hard parts" #1.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+import jax
+
+from dtf_tpu.core import dist
+from dtf_tpu.core.mesh import MeshConfig, make_mesh, mesh_summary
+
+log = logging.getLogger("dtf_tpu")
+
+
+def setup(FLAGS):
+    """Resolve cluster + mesh from parsed absl FLAGS.
+
+    Returns ``(mesh, info)``. For ``--job_name=ps`` this exits the process
+    with status 0 — the TPU-native successor of ``server.join()`` (the PS
+    role's state lives sharded on the mesh; the process has nothing to do).
+    """
+    info = dist.collapse_cluster_flags(
+        ps_hosts=[h for h in FLAGS.ps_hosts.split(",") if h],
+        worker_hosts=[h for h in FLAGS.worker_hosts.split(",") if h],
+        job_name=FLAGS.job_name,
+        task_index=FLAGS.task_index,
+    )
+    if info.should_exit:
+        log.warning("ps role has no work on the %s backend; exiting 0",
+                    FLAGS.backend)
+        sys.exit(0)
+    if not FLAGS.issync:
+        log.warning(
+            "--issync=0 (async PS SGD) is not reproduced on the TPU backend: "
+            "hogwild updates are an anti-pattern under SPMD. Proceeding with "
+            "synchronous aggregation (same convergence, no stale gradients).")
+    if FLAGS.backend == "cpu":
+        # Local-sim path: the test/dev equivalent of a multi-worker cluster.
+        jax.config.update("jax_platforms", "cpu")
+    dist.initialize(info)
+    mesh = make_mesh(MeshConfig(data=FLAGS.mesh_data, seq=FLAGS.mesh_seq,
+                                model=FLAGS.mesh_model))
+    if info.is_chief:
+        log.info("%s | %d process(es), chief=%s",
+                 mesh_summary(mesh), info.num_processes, info.is_chief)
+    return mesh, info

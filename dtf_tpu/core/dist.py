@@ -102,8 +102,7 @@ def initialize(info: ClusterInfo) -> None:
         return
     # Must not touch jax.devices()/process_count() here: any backend init
     # before jax.distributed.initialize() makes it raise.
-    from jax._src import distributed as _jdist
-    if _jdist.global_state.client is not None:  # already initialized
+    if jax.distributed.is_initialized():
         return
     jax.distributed.initialize(
         coordinator_address=info.coordinator_address,

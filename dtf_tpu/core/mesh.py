@@ -50,7 +50,10 @@ class MeshConfig:
         if seq <= 0 or model <= 0:
             raise ValueError(f"seq/model axis sizes must be positive, got {self}")
         data = self.data
-        if data <= 0:
+        if data == 0 or data < -1:
+            raise ValueError(
+                f"data axis size must be positive or -1 (infer), got {self}")
+        if data == -1:
             if n_devices % (seq * model):
                 raise ValueError(
                     f"{n_devices} devices not divisible by seq*model={seq * model}"

@@ -1,0 +1,255 @@
+"""The pjit'd train step — successor of the reference's entire L2 session layer.
+
+Reference capabilities replaced (SURVEY.md §3.1, §3.3):
+
+- ``SyncReplicasOptimizer`` (TF ``sync_replicas_optimizer.py``): accumulate N
+  worker gradients in PS-side ``ConditionalAccumulator``s, chief applies the
+  *mean*, token queue releases workers. Here the same numerics — gradient =
+  mean over the global batch — fall out of one compiled step: the batch is
+  sharded over the ``data`` axis, the loss is a global mean, and XLA inserts
+  the ICI all-reduce. Stale gradients cannot exist by construction; effective
+  batch = global batch (= replicas × per-replica batch, as in the reference).
+- Async-PS mode (``--issync=0``): intentionally racy hogwild updates. Not
+  reproduced — synchronous SPMD is the semantic successor (behavioral delta
+  documented in README).
+- Gradient accumulation + ZeRO-1 (BASELINE config 4): microbatch scan in f32
+  with optimizer state sharded over ``data`` (weight-update sharding).
+
+Design: everything here is *one* jitted function over global arrays; the
+ps/worker distinction, variable reads, and gradient pushes of the reference
+are all inside XLA's partitioned program, riding ICI instead of gRPC.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtf_tpu.core import sharding as shd
+from dtf_tpu.core.comms import batch_sharding, global_norm
+
+PyTree = Any
+#: loss_fn(params, extra, batch, rng) -> (loss, LossAux)
+LossFn = Callable[..., tuple[jax.Array, "LossAux"]]
+
+
+class LossAux(struct.PyTreeNode):
+    """What a loss function returns besides the scalar loss.
+
+    ``extra``: updated mutable collections (e.g. flax ``batch_stats``) — pass
+    through unchanged if unused. ``metrics``: scalar diagnostics, mean-reduced
+    across microbatches.
+    """
+
+    extra: PyTree = struct.field(default_factory=dict)
+    metrics: Mapping[str, jax.Array] = struct.field(default_factory=dict)
+
+
+class TrainState(struct.PyTreeNode):
+    """Replicated-by-name successor of the reference's PS-resident state.
+
+    The reference kept (variables, optimizer slots, global_step) on parameter
+    servers; here they are one pytree, sharded by ``NamedSharding``, donated
+    through the step. ``rng`` seeds per-step dropout etc. via fold_in(step).
+    """
+
+    step: jax.Array
+    params: PyTree
+    opt_state: PyTree
+    extra: PyTree
+    rng: jax.Array
+
+
+@dataclasses.dataclass(frozen=True)
+class StateShardings:
+    """NamedSharding pytree matching TrainState, for jit in/out shardings."""
+
+    state: TrainState  # of NamedShardings
+
+    def batch(self, mesh: Mesh) -> NamedSharding:
+        return batch_sharding(mesh)
+
+
+def state_specs(
+    init_fn: Callable[[jax.Array], PyTree],
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    mesh: Mesh,
+    param_rules: Sequence[shd.Rule] = (),
+    *,
+    zero1: bool = True,
+) -> TrainState:
+    """PartitionSpec pytree (as a TrainState) for the full training state.
+
+    ``init_fn(rng)`` must return the flax-style variables dict
+    (``{"params": ..., [other collections...]}``).
+    """
+    abstract = jax.eval_shape(init_fn, rng)
+    params = abstract["params"]
+    extra = {k: v for k, v in abstract.items() if k != "params"}
+    param_specs = shd.tree_specs(params, param_rules)
+    if zero1:
+        opt_specs = shd.zero1_opt_specs(tx, params, param_specs, mesh)
+    else:
+        opt_specs = shd.opt_specs_like_params(tx, params, param_specs)
+    # Mutable collections (batch_stats) are small; replicate them.
+    extra_specs = jax.tree.map(lambda _: P(), extra)
+    return TrainState(step=P(), params=param_specs, opt_state=opt_specs,
+                      extra=extra_specs, rng=P())
+
+
+def state_shardings_from_specs(specs: TrainState, mesh: Mesh) -> TrainState:
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def create_train_state(
+    init_fn: Callable[[jax.Array], PyTree],
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    mesh: Mesh,
+    param_rules: Sequence[shd.Rule] = (),
+    *,
+    zero1: bool = True,
+) -> tuple[TrainState, TrainState]:
+    """Initialize a sharded TrainState directly on the mesh.
+
+    Returns ``(state, shardings)``. Parameters materialize already sharded
+    (init is jitted with out_shardings), so no host-side full copy exists —
+    the moment the reference handled with chief-init + PS placement.
+    """
+    specs = state_specs(init_fn, tx, rng, mesh, param_rules, zero1=zero1)
+    shardings = state_shardings_from_specs(specs, mesh)
+
+    def init(rng):
+        variables = init_fn(rng)
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items() if k != "params"}
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            extra=extra,
+            rng=rng,
+        )
+
+    state = jax.jit(init, out_shardings=shardings)(rng)
+    return state, shardings
+
+
+def make_train_step(
+    loss_fn: LossFn,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings: TrainState,
+    *,
+    grad_accum: int = 1,
+    compute_dtype: jnp.dtype | None = None,
+    log_grad_norm: bool = True,
+    donate: bool = True,
+):
+    """Build the compiled train step.
+
+    ``loss_fn(params, extra, batch, rng) -> (loss, LossAux)`` computes the
+    *mean* loss over its (global) batch — with the batch sharded over ``data``
+    the resulting gradient is the mean over all replicas, which is exactly
+    ``SyncReplicasOptimizer``'s aggregation semantics (SURVEY.md §3.3).
+
+    ``grad_accum > 1``: the leading batch dim is split into ``grad_accum``
+    microbatches scanned with ``lax.scan``, gradients accumulated in f32
+    (BASELINE BERT config). The per-microbatch gradient mean is divided by
+    ``grad_accum`` so the result equals the full-batch mean gradient.
+    """
+
+    def grads_of(params, extra, micro, rng):
+        if compute_dtype is not None:
+            micro = jax.tree.map(
+                lambda x: x.astype(compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating) else x, micro)
+        (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, extra, micro, rng)
+        return loss, aux, grads
+
+    def step_fn(state: TrainState, batch: PyTree) -> tuple[TrainState, dict]:
+        rng = jax.random.fold_in(state.rng, state.step)
+
+        if grad_accum == 1:
+            loss, aux, grads = grads_of(state.params, state.extra, batch, rng)
+            metrics = dict(aux.metrics)
+            extra = aux.extra
+        else:
+            data_size = dict(
+                zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+
+            def to_micro(x):
+                if x.shape[0] % grad_accum or (
+                        x.shape[0] // grad_accum) % data_size:
+                    raise ValueError(
+                        f"global batch {x.shape[0]} with grad_accum="
+                        f"{grad_accum} gives microbatch "
+                        f"{x.shape[0] // grad_accum}, which must be divisible "
+                        f"by the data axis ({data_size} shards)")
+                # scan (microbatch) axis replicated; per-micro batch dim keeps
+                # the data sharding.
+                return jax.lax.reshape(
+                    x, (grad_accum, x.shape[0] // grad_accum) + x.shape[1:],
+                    out_sharding=NamedSharding(
+                        mesh, P(None, "data", *([None] * (x.ndim - 1)))))
+
+            micro = jax.tree.map(to_micro, batch)
+
+            def body(carry, mb):
+                acc, extra, i = carry
+                mb_rng = jax.random.fold_in(rng, i)
+                loss, aux, grads = grads_of(state.params, extra, mb, mb_rng)
+                acc = jax.tree.map(
+                    lambda a, g: a + g.astype(jnp.float32) / grad_accum,
+                    acc, grads)
+                return (acc, aux.extra, i + 1), (loss, aux.metrics)
+
+            acc0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (grads, extra, _), (losses, metric_seq) = jax.lax.scan(
+                body, (acc0, state.extra, jnp.zeros((), jnp.int32)), micro)
+            grads = jax.tree.map(
+                lambda g, p: g.astype(p.dtype), grads, state.params)
+            loss = losses.mean()
+            metrics = jax.tree.map(lambda m: m.mean(), dict(metric_seq))
+
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics["loss"] = loss
+        if log_grad_norm:
+            metrics["grad_norm"] = global_norm(grads)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            extra=extra)
+        return new_state, metrics
+
+    batch_sh = batch_sharding(mesh)
+    return jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState):
+    """Compiled eval step: ``eval_fn(params, extra, batch) -> metrics dict``."""
+
+    def step_fn(state: TrainState, batch: PyTree):
+        return eval_fn(state.params, state.extra, batch)
+
+    return jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sharding(mesh)),
+        out_shardings=NamedSharding(mesh, P()),
+    )

@@ -1,0 +1,1 @@
+"""Input pipeline: per-host sharded batches for the five BASELINE workloads."""

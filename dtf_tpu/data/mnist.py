@@ -1,0 +1,94 @@
+"""MNIST IDX reader — successor of tensorflow.examples.tutorials.mnist.input_data.
+
+The reference used the TF1 tutorial loader (``read_data_sets`` +
+``next_batch``), which is gone from TF 2.21 (verified in SURVEY.md §1 L3).
+This reads the same on-disk format (idx3-ubyte/idx1-ubyte, optionally .gz)
+from ``--data_dir`` and reproduces ``next_batch``'s shuffle-each-epoch
+semantics. When the files are absent (this container has no network), callers
+fall back to :mod:`dtf_tpu.data.synthetic`.
+
+A native (C++) accelerated path for batch assembly lives in
+:mod:`dtf_tpu.data.native`; this module is the pure-numpy reference
+implementation and the fallback.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Iterator
+
+import numpy as np
+
+FILES = {
+    "train_images": "train-images-idx3-ubyte",
+    "train_labels": "train-labels-idx1-ubyte",
+    "test_images": "t10k-images-idx3-ubyte",
+    "test_labels": "t10k-labels-idx1-ubyte",
+}
+
+
+def _open(path: str):
+    if os.path.exists(path + ".gz"):
+        return gzip.open(path + ".gz", "rb")
+    return open(path, "rb")
+
+
+def read_idx(path: str) -> np.ndarray:
+    """Parse an IDX file (the classic MNIST container format)."""
+    with _open(path) as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        dtype_code = (magic >> 8) & 0xFF
+        ndim = magic & 0xFF
+        if magic >> 16 or dtype_code != 0x08:
+            raise ValueError(f"{path}: unsupported IDX magic {magic:#x}")
+        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+        if data.size != int(np.prod(dims)):
+            raise ValueError(f"{path}: truncated IDX payload")
+        return data.reshape(dims)
+
+
+def available(data_dir: str) -> bool:
+    return all(
+        os.path.exists(os.path.join(data_dir, f))
+        or os.path.exists(os.path.join(data_dir, f + ".gz"))
+        for f in FILES.values())
+
+
+class MnistData:
+    """Shuffled epoch iterator with per-host sharding.
+
+    Matches the reference loader's semantics: images flattened to 784 floats
+    in [0,1), labels int32, reshuffled every epoch. Each host sees a disjoint
+    1/host_count slice of every epoch (the per-worker feed_dict successor).
+    """
+
+    def __init__(self, data_dir: str, batch_size: int, *, split: str = "train",
+                 seed: int = 0, host_index: int = 0, host_count: int = 1):
+        images = read_idx(os.path.join(data_dir, FILES[f"{split}_images"]))
+        labels = read_idx(os.path.join(data_dir, FILES[f"{split}_labels"]))
+        self.images = (images.reshape(len(images), -1) / 255.0).astype(
+            np.float32)
+        self.labels = labels.astype(np.int32)
+        if batch_size % host_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {host_count} hosts")
+        self.local_batch = batch_size // host_count
+        self.host_index = host_index
+        self.host_count = host_count
+        self.seed = seed
+
+    def __iter__(self) -> Iterator[dict]:
+        epoch = 0
+        n = len(self.images)
+        while True:
+            order = np.random.default_rng(
+                np.random.SeedSequence([self.seed, epoch])).permutation(n)
+            shard = order[self.host_index::self.host_count]
+            for i in range(0, len(shard) - self.local_batch + 1,
+                           self.local_batch):
+                idx = shard[i:i + self.local_batch]
+                yield {"image": self.images[idx], "label": self.labels[idx]}
+            epoch += 1

@@ -1,0 +1,103 @@
+"""Synthetic datasets for the five BASELINE workload configs.
+
+Successor of the reference's input layer (SURVEY.md §1 L3): the reference fed
+MNIST via the long-defunct ``tensorflow.examples.tutorials.mnist`` feed-dict
+reader. This environment has no network, so every workload gets a
+deterministic synthetic generator with the right shapes/dtypes; real data
+(IDX/tfrecord files in ``--data_dir``) plugs in via :mod:`dtf_tpu.data.mnist`
+when present. Parity tests (loss decreasing, numerics across mesh sizes) are
+data-agnostic by design.
+
+Each generator yields *host-local* numpy batches; multi-host jobs get
+disjoint shards via ``shard`` (the per-worker ``next_batch`` successor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import numpy as np
+
+Batch = dict
+
+
+@dataclasses.dataclass(frozen=True)
+class SyntheticSpec:
+    """Shape/dtype recipe for one workload config."""
+
+    name: str
+    num_classes: int
+
+
+def _rng_for(seed: int, step: int, host: int) -> np.random.Generator:
+    return np.random.default_rng(
+        np.random.SeedSequence([seed, step, host]))
+
+
+class SyntheticData:
+    """Deterministic, host-sharded synthetic batches.
+
+    ``kind`` ∈ {mnist, cifar, imagenet, bert, widedeep} — one per BASELINE
+    config. Labels are derived from the inputs (not pure noise) so that
+    models can actually fit them and "loss decreases" is a meaningful test.
+    """
+
+    def __init__(self, kind: str, batch_size: int, *, seed: int = 0,
+                 host_index: int = 0, host_count: int = 1,
+                 seq_len: int = 128, vocab_size: int = 30522,
+                 num_sparse: int = 26, hash_buckets: int = 1000):
+        if batch_size % host_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {host_count} hosts")
+        self.kind = kind
+        self.global_batch = batch_size
+        self.local_batch = batch_size // host_count
+        self.seed = seed
+        self.host = host_index
+        self.seq_len = seq_len
+        self.vocab = vocab_size
+        self.num_sparse = num_sparse
+        self.hash_buckets = hash_buckets
+        if kind not in ("mnist", "cifar", "imagenet", "bert", "widedeep"):
+            raise ValueError(f"unknown synthetic dataset kind: {kind!r}")
+
+    def batch(self, step: int) -> Batch:
+        r = _rng_for(self.seed, step, self.host)
+        n = self.local_batch
+        if self.kind == "mnist":
+            x = r.random((n, 784), np.float32)
+            w = _rng_for(self.seed, 0, 0).standard_normal((784, 10))
+            y = (x @ w).argmax(-1).astype(np.int32)
+            return {"image": x, "label": y}
+        if self.kind == "cifar":
+            x = r.random((n, 32, 32, 3), np.float32)
+            y = (x.mean((1, 2)) @ _rng_for(self.seed, 0, 0)
+                 .standard_normal((3, 10))).argmax(-1).astype(np.int32)
+            return {"image": x, "label": y}
+        if self.kind == "imagenet":
+            x = r.random((n, 224, 224, 3), np.float32)
+            y = r.integers(0, 1000, (n,), np.int32)
+            return {"image": x, "label": y}
+        if self.kind == "bert":
+            ids = r.integers(0, self.vocab, (n, self.seq_len), np.int32)
+            mask_pos = r.random((n, self.seq_len)) < 0.15
+            labels = np.where(mask_pos, ids, -100).astype(np.int32)
+            masked = np.where(mask_pos, 103, ids).astype(np.int32)  # [MASK]
+            segment = np.zeros((n, self.seq_len), np.int32)
+            return {"input_ids": masked, "segment_ids": segment,
+                    "attention_mask": np.ones((n, self.seq_len), np.int32),
+                    "mlm_labels": labels}
+        # widedeep: criteo-like 13 dense + num_sparse categorical features.
+        dense = r.standard_normal((n, 13)).astype(np.float32)
+        sparse = r.integers(0, self.hash_buckets,
+                            (n, self.num_sparse), np.int32)
+        logits = dense.sum(-1) + (sparse.sum(-1) % 7 - 3) * 0.3
+        y = (logits > 0).astype(np.float32)
+        return {"dense": dense, "sparse": sparse, "label": y}
+
+    def __iter__(self) -> Iterator[Batch]:
+        step = 0
+        while True:
+            yield self.batch(step)
+            step += 1

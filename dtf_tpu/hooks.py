@@ -1,0 +1,128 @@
+"""Session hooks — successor of tf.train.SessionRunHook and the chief's hook set.
+
+Reference capability replaced (SURVEY.md §3.4): ``MonitoredTrainingSession``
+installs ``CheckpointSaverHook``, ``SummarySaverHook``, ``StopAtStepHook``,
+``LoggingTensorHook`` on the chief. The same lifecycle — begin / before-step /
+after-step / end — is kept so reference users find the familiar shape, but
+hooks run on host Python around an async dispatched step, so they cost
+nothing on the device timeline unless they block on results.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Mapping
+
+import jax
+
+from dtf_tpu.checkpoint import Checkpointer
+from dtf_tpu.metrics import MetricWriter
+
+PyTree = Any
+
+
+class StopTraining(Exception):
+    """Raised by a hook to end the loop (the ``should_stop()`` successor)."""
+
+
+class Hook:
+    def begin(self, state: PyTree) -> None: ...
+
+    def before_step(self, step: int) -> None: ...
+
+    def after_step(self, step: int, state: PyTree,
+                   metrics: Mapping[str, jax.Array]) -> None: ...
+
+    def end(self, state: PyTree) -> None: ...
+
+
+class StopAtStepHook(Hook):
+    """``tf.train.StopAtStepHook`` equivalent (last_step semantics)."""
+
+    def __init__(self, last_step: int):
+        self.last_step = last_step
+
+    def before_step(self, step):
+        # A resumed state may already be at/past last_step; stop before
+        # running an extra step (MonitoredSession checks should_stop()
+        # before run(), not only after).
+        if step >= self.last_step:
+            raise StopTraining
+
+    def after_step(self, step, state, metrics):
+        if step >= self.last_step:
+            raise StopTraining
+
+
+class LoggingHook(Hook):
+    """Step/loss/throughput logging — ``LoggingTensorHook`` + ``print`` path.
+
+    Materializing ``metrics`` blocks on the async step, so this is also the
+    loop's backpressure point; every_n trades log freshness for overlap.
+    """
+
+    def __init__(self, writer: MetricWriter, every_n: int = 10):
+        self.writer = writer
+        self.every_n = every_n
+        self._t0 = None
+        self._last_logged = None
+
+    def begin(self, state):
+        self._t0 = time.perf_counter()
+        self._last_logged = int(state.step)
+
+    def after_step(self, step, state, metrics):
+        if step % self.every_n:
+            return
+        now = time.perf_counter()
+        steps_done = step - self._last_logged
+        sps = steps_done / max(now - self._t0, 1e-9)
+        self._t0, self._last_logged = now, step
+        scalars = {k: float(v) for k, v in metrics.items()}
+        scalars["steps_per_sec"] = sps
+        self.writer.write_scalars(step, scalars)
+
+    def end(self, state):
+        self.writer.flush()
+
+
+class CheckpointHook(Hook):
+    """``CheckpointSaverHook`` equivalent: periodic async sharded saves,
+    final save + barrier at end. Orbax dedupes by save_interval_steps."""
+
+    def __init__(self, ckpt: Checkpointer, every_n: int = 100):
+        self.ckpt = ckpt
+        self.every_n = every_n
+
+    def after_step(self, step, state, metrics):
+        if step % self.every_n == 0:
+            self.ckpt.save(step, state)
+
+    def end(self, state):
+        self.ckpt.save(int(state.step), state, force=True)
+        self.ckpt.wait()
+
+
+class ProfilerHook(Hook):
+    """``tf.profiler``/Timeline equivalent: capture an XPlane trace window."""
+
+    def __init__(self, logdir: str, start_step: int = 10, num_steps: int = 5):
+        self.logdir = logdir
+        self.start = start_step
+        self.stop = start_step + num_steps
+        self._active = False
+
+    def before_step(self, step):
+        if step == self.start and jax.process_index() == 0:
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+
+    def after_step(self, step, state, metrics):
+        if self._active and step >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def end(self, state):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

@@ -1,0 +1,85 @@
+"""The monitored training loop — successor of MonitoredTrainingSession.
+
+Reference capability replaced (SURVEY.md §3.4, §5.3): the reference wraps
+``tf.Session`` in ``MonitoredSession`` (hook dispatch) and
+``_RecoverableSession`` (on worker failure: rebuild the session, restore the
+last checkpoint, continue). Here the loop is plain host Python around one
+compiled step; recovery keeps the same semantics via checkpoint-restart —
+``Trainer.fit`` restores the latest checkpoint if one exists before training
+(crash → relaunch → resume), which is exactly the reference's story minus the
+in-process session rebuild (a dead process is relaunched by the cluster
+manager either way).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Iterable, Sequence
+
+import jax
+
+from dtf_tpu.checkpoint import Checkpointer
+from dtf_tpu.core.comms import shard_batch
+from dtf_tpu.hooks import Hook, StopTraining
+
+PyTree = Any
+log = logging.getLogger("dtf_tpu")
+
+
+class Trainer:
+    """Hook-driven loop over a compiled train step.
+
+    ``train_step(state, batch) -> (state, metrics)`` is the jitted function
+    from :func:`dtf_tpu.core.train.make_train_step`. ``place_batch`` maps a
+    host batch onto the mesh (defaults to data-axis sharding; multi-host
+    pipelines pass ``comms.host_local_to_global``-based placement).
+    """
+
+    def __init__(
+        self,
+        train_step: Callable[[PyTree, PyTree], tuple[PyTree, dict]],
+        mesh,
+        hooks: Sequence[Hook] = (),
+        *,
+        checkpointer: Checkpointer | None = None,
+        place_batch: Callable | None = None,
+    ):
+        self.train_step = train_step
+        self.mesh = mesh
+        self.hooks = list(hooks)
+        self.checkpointer = checkpointer
+        self.place_batch = place_batch or (
+            lambda batch: shard_batch(batch, self.mesh))
+
+    def fit(self, state: PyTree, batches: Iterable[PyTree],
+            *, max_steps: int | None = None) -> PyTree:
+        """Run until the iterator ends, a hook stops training, or max_steps.
+
+        Restore-if-exists first (``ChiefSessionCreator`` semantics): if the
+        checkpointer has a saved step, training resumes from it — the
+        relaunch path after a failure needs no special casing.
+        """
+        if self.checkpointer is not None:
+            state, restored = self.checkpointer.restore_if_exists(state)
+            if restored is not None:
+                log.info("resumed from checkpoint at step %d", restored)
+
+        for h in self.hooks:
+            h.begin(state)
+        try:
+            for batch in batches:
+                step = int(state.step)
+                if max_steps is not None and step >= max_steps:
+                    break
+                for h in self.hooks:
+                    h.before_step(step)
+                state, metrics = self.train_step(state, self.place_batch(batch))
+                step += 1
+                for h in self.hooks:
+                    h.after_step(step, state, metrics)
+        except StopTraining:
+            pass
+        finally:
+            for h in self.hooks:
+                h.end(state)
+        return state

@@ -1,0 +1,68 @@
+"""Metrics / observability — successor of SummarySaverHook + LoggingTensorHook.
+
+Reference capability replaced (SURVEY.md §5.5): scalar loss/accuracy to
+TensorBoard via ``tf.summary.FileWriter`` (chief only) and stdout step logs.
+Here: ``clu.metric_writers`` (TensorBoard summaries + logging), written only
+by process 0, plus host-side logging from inside jit via
+``jax.debug.callback`` (the supported successor of the removed
+``jax.experimental.host_callback`` named in the north star).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Mapping
+
+import jax
+
+log = logging.getLogger("dtf_tpu")
+
+
+class MetricWriter:
+    """Scalar writer: stdout logging always, TensorBoard when logdir given."""
+
+    def __init__(self, logdir: str | None = None, *, also_log: bool = True):
+        self._writers = []
+        self._is_chief = jax.process_index() == 0
+        if not self._is_chief:
+            return
+        if also_log:
+            from clu.metric_writers import LoggingWriter
+
+            self._writers.append(LoggingWriter())
+        if logdir:
+            try:
+                from clu.metric_writers import SummaryWriter
+
+                self._writers.append(SummaryWriter(logdir))
+            except Exception as e:  # pragma: no cover - env-dependent (TF)
+                log.warning("TensorBoard summary writer unavailable: %s", e)
+
+    def write_scalars(self, step: int, scalars: Mapping[str, float]) -> None:
+        if not self._writers:
+            return
+        scalars = {k: float(v) for k, v in scalars.items()}
+        for w in self._writers:
+            w.write_scalars(int(step), scalars)
+
+    def flush(self) -> None:
+        for w in self._writers:
+            w.flush()
+
+    def close(self) -> None:
+        for w in self._writers:
+            w.close()
+
+
+def jit_log(fmt: str, **values) -> None:
+    """Log scalars from inside a jitted function (host callback).
+
+    Usage inside a loss/step function: ``jit_log("loss={loss}", loss=loss)``.
+    Unlike the reference's ``LoggingTensorHook`` (which ran a separate fetch
+    through the session), this rides the compiled program asynchronously.
+    """
+
+    def _cb(**kw):
+        log.info(fmt.format(**{k: float(v) for k, v in kw.items()}))
+
+    jax.debug.callback(_cb, **values)

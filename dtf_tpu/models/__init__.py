@@ -1,0 +1,7 @@
+"""Model zoo: one model per BASELINE workload config.
+
+- mnist: softmax regression (the reference's actual model) + MLP
+- resnet: ResNet-20 (CIFAR) / ResNet-50 (ImageNet)
+- bert: BERT-base encoder MLM pretraining
+- widedeep: Wide&Deep recsys with row-sharded embedding tables
+"""

@@ -1,0 +1,75 @@
+"""MNIST softmax regression — the reference's actual workload (BASELINE config 1).
+
+The reference builds ``y = softmax(Wx + b)`` with cross-entropy loss and
+``GradientDescentOptimizer`` under ``replica_device_setter`` (SURVEY.md §1
+L3). Here it's a flax module; placement is a rule set instead of a device
+function, and the sync-replica aggregation comes from the shared train step.
+An MLP variant is included for a non-trivial-capacity smoke model.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from dtf_tpu.core.train import LossAux
+
+
+class SoftmaxRegression(nn.Module):
+    """Single dense layer, exactly the reference model."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.num_classes, name="logits")(x)
+
+
+class MLP(nn.Module):
+    hidden: tuple[int, ...] = (128,)
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        for i, h in enumerate(self.hidden):
+            x = nn.relu(nn.Dense(h, name=f"hidden_{i}")(x))
+        return nn.Dense(self.num_classes, name="logits")(x)
+
+
+def make_model(kind: str = "softmax") -> nn.Module:
+    return SoftmaxRegression() if kind == "softmax" else MLP()
+
+
+def make_init(model: nn.Module, input_dim: int = 784):
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1, input_dim), jnp.float32))
+
+    return init_fn
+
+
+def make_loss(model: nn.Module):
+    """Mean softmax cross-entropy — mean over the *global* batch, which under
+    a data-sharded batch reproduces SyncReplicasOptimizer's mean-of-replicas
+    gradient (SURVEY.md §3.3)."""
+
+    def loss_fn(params, extra, batch, rng):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, LossAux(extra=extra, metrics={"accuracy": acc})
+
+    return loss_fn
+
+
+def make_eval(model: nn.Module):
+    def eval_fn(params, extra, batch):
+        logits = model.apply({"params": params}, batch["image"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return {"eval_loss": loss, "eval_accuracy": acc}
+
+    return eval_fn

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""MNIST softmax training — the reference repo's `distributed.py`, TPU-native.
+
+Launch-compatible with the reference (SURVEY.md §1 L6): the same command
+shape works, e.g.
+
+    python scripts/distributed.py \
+        --ps_hosts=localhost:2222 --worker_hosts=localhost:2223,localhost:2224 \
+        --job_name=worker --task_index=0 --issync=1 --backend=tpu
+
+On the TPU backend the ps/worker roles collapse (ps processes exit 0; workers
+become JAX processes over one device mesh); a single-process launch with no
+cluster flags trains on all local devices. `--backend=cpu` runs the same
+program on a simulated mesh for development.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags, logging as absl_logging
+
+from dtf_tpu.cli import flags as dflags
+
+dflags.define_cluster_flags()
+dflags.define_mesh_flags()
+dflags.define_train_flags(batch_size=64, learning_rate=0.01, train_steps=200)
+flags.DEFINE_string("model", "softmax", "softmax | mlp")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    absl_logging.use_python_logging()
+    import logging
+
+    logging.getLogger("dtf_tpu").setLevel(logging.INFO)
+    import jax
+    import optax
+
+    from dtf_tpu.checkpoint import Checkpointer
+    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.data import mnist as mnist_data
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
+    from dtf_tpu.loop import Trainer
+    from dtf_tpu.metrics import MetricWriter
+    from dtf_tpu.models import mnist as mnist_model
+
+    mesh, info = setup(FLAGS)
+
+    model = mnist_model.make_model(FLAGS.model)
+    # GradientDescentOptimizer equivalent; the reference used plain SGD.
+    tx = optax.sgd(FLAGS.learning_rate)
+    state, shardings = tr.create_train_state(
+        mnist_model.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed),
+        mesh)
+    step = tr.make_train_step(mnist_model.make_loss(model), tx, mesh,
+                              shardings, grad_accum=FLAGS.grad_accum)
+
+    if FLAGS.data_dir and mnist_data.available(FLAGS.data_dir):
+        data = mnist_data.MnistData(
+            FLAGS.data_dir, FLAGS.batch_size, seed=FLAGS.seed,
+            host_index=info.process_id, host_count=info.num_processes)
+    else:
+        if FLAGS.data_dir:
+            absl_logging.warning("MNIST files not found in %s; using "
+                                 "synthetic data", FLAGS.data_dir)
+        data = SyntheticData(
+            "mnist", FLAGS.batch_size, seed=FLAGS.seed,
+            host_index=info.process_id, host_count=info.num_processes)
+
+    writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
+    ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
+                        save_interval_steps=FLAGS.checkpoint_every)
+    trainer = Trainer(
+        step, mesh,
+        hooks=[LoggingHook(writer, FLAGS.log_every),
+               CheckpointHook(ckpt, FLAGS.checkpoint_every),
+               StopAtStepHook(FLAGS.train_steps)],
+        checkpointer=ckpt)
+    state = trainer.fit(state, iter(data))
+    writer.close()
+    ckpt.close()
+    print(f"done: step={int(state.step)}")
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,94 @@
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from dtf_tpu.data.mnist import MnistData, available, read_idx
+from dtf_tpu.data.synthetic import SyntheticData
+
+
+def test_synthetic_shapes_all_kinds():
+    shapes = {
+        "mnist": {"image": (8, 784), "label": (8,)},
+        "cifar": {"image": (8, 32, 32, 3), "label": (8,)},
+        "imagenet": {"image": (8, 224, 224, 3), "label": (8,)},
+        "bert": {"input_ids": (8, 128), "mlm_labels": (8, 128)},
+        "widedeep": {"dense": (8, 13), "sparse": (8, 26), "label": (8,)},
+    }
+    for kind, want in shapes.items():
+        b = SyntheticData(kind, 8).batch(0)
+        for k, shape in want.items():
+            assert b[k].shape == shape, (kind, k)
+
+
+def test_synthetic_deterministic_and_host_sharded():
+    a = SyntheticData("mnist", 16, seed=1).batch(3)
+    b = SyntheticData("mnist", 16, seed=1).batch(3)
+    np.testing.assert_array_equal(a["image"], b["image"])
+    h0 = SyntheticData("mnist", 16, seed=1, host_index=0, host_count=2).batch(0)
+    h1 = SyntheticData("mnist", 16, seed=1, host_index=1, host_count=2).batch(0)
+    assert h0["image"].shape == (8, 784)
+    assert not np.array_equal(h0["image"], h1["image"])
+
+
+def test_synthetic_rejects_bad_config():
+    with pytest.raises(ValueError, match="divisible"):
+        SyntheticData("mnist", 10, host_count=4)
+    with pytest.raises(ValueError, match="unknown"):
+        SyntheticData("nope", 8)
+
+
+def _write_idx(path, arr, gz=False):
+    arr = np.asarray(arr, np.uint8)
+    header = struct.pack(f">I{arr.ndim}I", 0x0800 | arr.ndim, *arr.shape)
+    opener = gzip.open if gz else open
+    with opener(path + (".gz" if gz else ""), "wb") as f:
+        f.write(header + arr.tobytes())
+
+
+@pytest.fixture
+def mnist_dir(tmp_path):
+    d = str(tmp_path)
+    r = np.random.RandomState(0)
+    _write_idx(os.path.join(d, "train-images-idx3-ubyte"),
+               r.randint(0, 256, (64, 28, 28)))
+    _write_idx(os.path.join(d, "train-labels-idx1-ubyte"),
+               r.randint(0, 10, (64,)), gz=True)
+    _write_idx(os.path.join(d, "t10k-images-idx3-ubyte"),
+               r.randint(0, 256, (16, 28, 28)))
+    _write_idx(os.path.join(d, "t10k-labels-idx1-ubyte"),
+               r.randint(0, 10, (16,)))
+    return d
+
+
+def test_idx_roundtrip(mnist_dir):
+    imgs = read_idx(os.path.join(mnist_dir, "train-images-idx3-ubyte"))
+    assert imgs.shape == (64, 28, 28)
+    labels = read_idx(os.path.join(mnist_dir, "train-labels-idx1-ubyte"))
+    assert labels.shape == (64,)  # read through .gz
+    assert available(mnist_dir)
+
+
+def test_mnist_iterator_shards_and_reshuffles(mnist_dir):
+    it0 = iter(MnistData(mnist_dir, 16, host_index=0, host_count=2))
+    it1 = iter(MnistData(mnist_dir, 16, host_index=1, host_count=2))
+    b0, b1 = next(it0), next(it1)
+    assert b0["image"].shape == (8, 784)
+    assert b0["image"].dtype == np.float32
+    assert b0["image"].max() <= 1.0
+    assert not np.array_equal(b0["image"], b1["image"])
+    # one epoch = 64/2/8 = 4 batches per host; 5th batch starts epoch 2 with
+    # a different permutation.
+    epoch1 = [next(it0)["label"] for _ in range(3)]
+    epoch2_first = next(it0)["label"]
+    assert not np.array_equal(np.sort(b0["label"]), epoch2_first)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = os.path.join(str(tmp_path), "bad")
+    with open(p, "wb") as f:
+        f.write(b"\x12\x34\x56\x78" + b"\x00" * 16)
+    with pytest.raises(ValueError, match="magic"):
+        read_idx(p)
