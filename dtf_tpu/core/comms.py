@@ -84,21 +84,51 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh, *, batch_dim: int = 0,
-                   axis: str | tuple[str, ...] = "data") -> NamedSharding:
-    """Sharding for a batch: leading dim split over the data axis."""
-    spec = [None] * (batch_dim + 1)
-    spec[batch_dim] = axis
-    return NamedSharding(mesh, P(*spec))
+                   axis: str | tuple[str, ...] = "data",
+                   spec: P | None = None) -> NamedSharding:
+    """Sharding for a batch: leading dim split over the data axis, or an
+    explicit ``spec`` (e.g. ``P('data', 'seq')`` for context parallelism)."""
+    if spec is None:
+        s = [None] * (batch_dim + 1)
+        s[batch_dim] = axis
+        spec = P(*s)
+    return NamedSharding(mesh, spec)
 
 
-def shard_batch(batch: PyTree, mesh: Mesh, *, batch_dim: int = 0) -> PyTree:
-    """Place a host-global batch onto the mesh, split over ``data``.
+def shard_batch(batch: PyTree, mesh: Mesh, *, batch_dim: int = 0,
+                spec: P | None = None) -> PyTree:
+    """Place a host-global batch onto the mesh, split over ``data`` (or an
+    explicit spec; extra spec dims are dropped per-leaf for lower-rank leaves,
+    so ``P('data','seq')`` works for a batch mixing [B] and [B,T] arrays).
 
     Single-process path. For multi-host (each process holding its slice of
     the global batch) use :func:`host_local_to_global`.
     """
-    sh = batch_sharding(mesh, batch_dim=batch_dim)
-    return jax.tree.map(lambda x: jax.device_put(x, sh), batch)
+
+    def put(x):
+        s = spec
+        if s is not None and x.ndim < len(s):
+            s = P(*list(s)[: x.ndim])
+        sh = batch_sharding(mesh, batch_dim=batch_dim, spec=s)
+        return jax.device_put(x, sh)
+
+    return jax.tree.map(put, batch)
+
+
+def batch_shardings_for(example_batch: PyTree, mesh: Mesh,
+                        spec: P) -> PyTree:
+    """Per-leaf NamedShardings from a spec, truncated to each leaf's rank.
+
+    ``P('data', 'seq')`` → [B,T] leaves shard batch+sequence, [B] leaves
+    shard batch only. Pass the result to ``make_train_step(batch_shardings=)``
+    and place batches with ``shard_batch(..., spec=...)``.
+    """
+
+    def leaf_sharding(x):
+        s = list(spec)[: x.ndim]
+        return NamedSharding(mesh, P(*s))
+
+    return jax.tree.map(leaf_sharding, example_batch)
 
 
 def host_local_to_global(local_batch: PyTree, mesh: Mesh,

@@ -79,13 +79,20 @@ def make_mesh(
     config = config or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
     shape = config.resolve(len(devices))
-    return jax.make_mesh(shape, AXES, devices=devices)
+    # Auto axis types = classic GSPMD: the compiler propagates shardings and
+    # inserts collectives (the design stance of SURVEY.md §7 — annotate at
+    # the jit boundary, let XLA place the psum/all-gathers). The 0.9 default
+    # (Explicit) would demand per-op out_sharding annotations instead.
+    return jax.make_mesh(
+        shape, AXES, devices=devices,
+        axis_types=(jax.sharding.AxisType.Auto,) * len(AXES))
 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     """A 1x1x1 mesh for single-chip runs (the local dev/bench path)."""
     device = device or jax.devices()[0]
-    return jax.make_mesh((1, 1, 1), AXES, devices=[device])
+    return jax.make_mesh((1, 1, 1), AXES, devices=[device],
+                         axis_types=(jax.sharding.AxisType.Auto,) * len(AXES))
 
 
 def mesh_summary(mesh: Mesh) -> str:

@@ -44,12 +44,18 @@ class LossAux(struct.PyTreeNode):
     """What a loss function returns besides the scalar loss.
 
     ``extra``: updated mutable collections (e.g. flax ``batch_stats``) — pass
-    through unchanged if unused. ``metrics``: scalar diagnostics, mean-reduced
-    across microbatches.
+    through unchanged if unused. ``metrics``: scalar diagnostics,
+    weight-averaged across microbatches. ``weight``: this batch's
+    contribution weight under
+    gradient accumulation — losses that normalize by a data-dependent count
+    (e.g. MLM valid positions) must return that count here so microbatch
+    gradients combine as Σwᵢgᵢ/Σwᵢ (== the full-batch gradient) instead of a
+    uniform mean.
     """
 
     extra: PyTree = struct.field(default_factory=dict)
     metrics: Mapping[str, jax.Array] = struct.field(default_factory=dict)
+    weight: jax.Array | float = 1.0
 
 
 class TrainState(struct.PyTreeNode):
@@ -154,6 +160,7 @@ def make_train_step(
     compute_dtype: jnp.dtype | None = None,
     log_grad_norm: bool = True,
     donate: bool = True,
+    batch_shardings: PyTree | None = None,
 ):
     """Build the compiled train step.
 
@@ -164,8 +171,10 @@ def make_train_step(
 
     ``grad_accum > 1``: the leading batch dim is split into ``grad_accum``
     microbatches scanned with ``lax.scan``, gradients accumulated in f32
-    (BASELINE BERT config). The per-microbatch gradient mean is divided by
-    ``grad_accum`` so the result equals the full-batch mean gradient.
+    (BASELINE BERT config) as Σwᵢgᵢ/Σwᵢ with wᵢ = ``LossAux.weight`` (1.0 by
+    default, giving the plain mean; count-normalized losses return their
+    valid count so the result equals the full-batch gradient exactly).
+    Loss and metrics combine with the same weights.
     """
 
     def grads_of(params, extra, micro, rng):
@@ -197,31 +206,37 @@ def make_train_step(
                         f"{x.shape[0] // grad_accum}, which must be divisible "
                         f"by the data axis ({data_size} shards)")
                 # scan (microbatch) axis replicated; per-micro batch dim keeps
-                # the data sharding.
-                return jax.lax.reshape(
-                    x, (grad_accum, x.shape[0] // grad_accum) + x.shape[1:],
-                    out_sharding=NamedSharding(
+                # the data sharding (constraint guides GSPMD propagation).
+                y = x.reshape(
+                    (grad_accum, x.shape[0] // grad_accum) + x.shape[1:])
+                return jax.lax.with_sharding_constraint(
+                    y, NamedSharding(
                         mesh, P(None, "data", *([None] * (x.ndim - 1)))))
 
             micro = jax.tree.map(to_micro, batch)
 
             def body(carry, mb):
-                acc, extra, i = carry
+                acc, w_sum, extra, i = carry
                 mb_rng = jax.random.fold_in(rng, i)
                 loss, aux, grads = grads_of(state.params, extra, mb, mb_rng)
+                w = jnp.asarray(aux.weight, jnp.float32)
                 acc = jax.tree.map(
-                    lambda a, g: a + g.astype(jnp.float32) / grad_accum,
-                    acc, grads)
-                return (acc, aux.extra, i + 1), (loss, aux.metrics)
+                    lambda a, g: a + g.astype(jnp.float32) * w, acc, grads)
+                return ((acc, w_sum + w, aux.extra, i + 1),
+                        (loss * w, w, aux.metrics))
 
             acc0 = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (grads, extra, _), (losses, metric_seq) = jax.lax.scan(
-                body, (acc0, state.extra, jnp.zeros((), jnp.int32)), micro)
+            (grads, w_sum, extra, _), (losses, ws, metric_seq) = jax.lax.scan(
+                body,
+                (acc0, jnp.zeros((), jnp.float32), state.extra,
+                 jnp.zeros((), jnp.int32)),
+                micro)
             grads = jax.tree.map(
-                lambda g, p: g.astype(p.dtype), grads, state.params)
-            loss = losses.mean()
-            metrics = jax.tree.map(lambda m: m.mean(), dict(metric_seq))
+                lambda g, p: (g / w_sum).astype(p.dtype), grads, state.params)
+            loss = losses.sum() / w_sum
+            metrics = jax.tree.map(
+                lambda m: (m * ws).sum() / w_sum, dict(metric_seq))
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
@@ -233,7 +248,11 @@ def make_train_step(
             extra=extra)
         return new_state, metrics
 
-    batch_sh = batch_sharding(mesh)
+    # batch_shardings: a full pytree (from comms.batch_shardings_for) when
+    # leaves need rank-dependent specs (e.g. P('data','seq') for [B,T] token
+    # ids but P('data') for [B] labels); default is the P('data') prefix.
+    batch_sh = (batch_shardings if batch_shardings is not None
+                else batch_sharding(mesh))
     return jax.jit(
         step_fn,
         in_shardings=(shardings, batch_sh),

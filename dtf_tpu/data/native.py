@@ -1,0 +1,133 @@
+"""ctypes bindings for the native (C++) data-loading runtime.
+
+``libdtfio.so`` (see ``dtf_tpu/native/dtfio.cpp``) does mmap'd IDX parsing,
+deterministic per-epoch shuffling, u8→f32 normalization, and batch assembly
+on a background prefetch thread with a double buffer — the successor of the
+reference era's C++ FIFOQueue/queue-runner input machinery (SURVEY.md §2b
+N7). Python's only per-batch work is a memcpy into a numpy array.
+
+Builds on demand with g++ (cached next to the source); falls back cleanly if
+no compiler is available — callers should use :func:`native_available` and
+fall back to :class:`dtf_tpu.data.mnist.MnistData`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Iterator
+
+import numpy as np
+
+log = logging.getLogger("dtf_tpu")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libdtfio.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, text=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError) as e:
+        out = getattr(e, "stderr", "")
+        log.warning("native dtfio build failed: %s %s", e, out)
+        return False
+
+
+def _load():
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        src = os.path.join(_NATIVE_DIR, "dtfio.cpp")
+        if not os.path.exists(_SO_PATH) or (
+                os.path.exists(src)
+                and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)):
+            if not _build():
+                return None
+        lib = ctypes.CDLL(_SO_PATH)
+        lib.dtfio_loader_create.restype = ctypes.c_void_p
+        lib.dtfio_loader_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.c_size_t, ctypes.c_size_t]
+        lib.dtfio_item_size.restype = ctypes.c_size_t
+        lib.dtfio_item_size.argtypes = [ctypes.c_void_p]
+        lib.dtfio_num_items.restype = ctypes.c_size_t
+        lib.dtfio_num_items.argtypes = [ctypes.c_void_p]
+        lib.dtfio_loader_next.restype = None
+        lib.dtfio_loader_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.dtfio_loader_destroy.restype = None
+        lib.dtfio_loader_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+class NativeIdxData:
+    """Prefetching IDX batch loader backed by libdtfio.
+
+    Same contract as :class:`dtf_tpu.data.mnist.MnistData` (host-sharded,
+    reshuffled epochs, f32 images in [0,1)), but assembly runs in native code
+    one batch ahead of the consumer. The shuffle is splitmix64-based, so
+    batch order differs from the numpy loader at equal seeds (both are
+    deterministic in themselves).
+    """
+
+    def __init__(self, images_path: str, labels_path: str, batch_size: int,
+                 *, seed: int = 0, host_index: int = 0, host_count: int = 1):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("libdtfio.so unavailable (no compiler?)")
+        if batch_size % host_count:
+            raise ValueError(
+                f"global batch {batch_size} not divisible by {host_count} hosts")
+        self._lib = lib
+        self.local_batch = batch_size // host_count
+        self._h = lib.dtfio_loader_create(
+            images_path.encode(), labels_path.encode(), self.local_batch,
+            seed, host_index, host_count)
+        if not self._h:
+            raise ValueError(
+                f"dtfio could not open {images_path}/{labels_path} "
+                "(bad IDX, mismatched item counts, or batch > shard)")
+        self.item_size = lib.dtfio_item_size(self._h)
+        self.num_items = lib.dtfio_num_items(self._h)
+
+    def next_batch(self) -> dict:
+        if not self._h:
+            raise RuntimeError("NativeIdxData used after close()")
+        images = np.empty((self.local_batch, self.item_size), np.float32)
+        labels = np.empty((self.local_batch,), np.int32)
+        self._lib.dtfio_loader_next(
+            self._h,
+            images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        return {"image": images, "label": labels}
+
+    def __iter__(self) -> Iterator[dict]:
+        while True:
+            yield self.next_batch()
+
+    def close(self):
+        if self._h:
+            self._lib.dtfio_loader_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

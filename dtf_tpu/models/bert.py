@@ -1,0 +1,194 @@
+"""BERT-base MLM pretraining — BASELINE config 4 (grad-accum + ZeRO-1).
+
+The reference never shipped a BERT, but the capability row demands it:
+"BERT-base pretraining (grad-accum + ZeRO-1 optimizer-state sharding)". This
+is a from-scratch flax encoder designed for the mesh:
+
+- Megatron-style TP over the ``model`` axis (qkv/mlp-in column-sharded,
+  attn-out/mlp-out row-sharded, embeddings vocab-sharded) via
+  :data:`tp_rules` — the GSPMD successor of PS-sharded variables.
+- Sequence/context parallelism over the ``seq`` axis via ring attention
+  (:func:`dtf_tpu.ops.attention.ring_attention_sharded`).
+- MLM loss through the one-hot sharded cross-entropy
+  (:func:`dtf_tpu.ops.losses.softmax_cross_entropy`) so vocab-sharded logits
+  never need a sharded gather.
+- bf16 compute, f32 params/layernorms; post-LN like original BERT.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.core.train import LossAux
+from dtf_tpu.ops import attention as att
+from dtf_tpu.ops.losses import softmax_cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    intermediate: int = 3072
+    max_positions: int = 512
+    type_vocab: int = 2
+    dropout: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        return BertConfig(vocab_size=128, hidden=32, layers=2, heads=4,
+                          intermediate=64, max_positions=64, dropout=0.0, **kw)
+
+
+#: Megatron-style TP placement over the `model` mesh axis (SURVEY.md §2c TP).
+tp_rules = [
+    (r"token_embed/embedding", P("model", None)),       # vocab-sharded rows
+    (r"(query|key|value)/kernel", P(None, "model")),    # column parallel
+    (r"attn_out/kernel", P("model", None)),             # row parallel
+    (r"mlp_in/kernel", P(None, "model")),
+    (r"mlp_out/kernel", P("model", None)),
+    (r"(query|key|value|mlp_in)/bias", P("model")),
+    (r"mlm_dense/kernel", P(None, "model")),
+    (r"mlm_bias", P("model")),
+]
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+    mesh: Optional[Mesh]
+
+    @nn.compact
+    def __call__(self, x, pad_mask, deterministic: bool):
+        cfg = self.cfg
+        d_head = cfg.hidden // cfg.heads
+        dense = lambda name: nn.Dense(  # noqa: E731
+            cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        # [B,T,Hd] → [B,H,T,D]
+        def split(t):
+            return t.reshape(t.shape[0], t.shape[1], cfg.heads,
+                             d_head).transpose(0, 2, 1, 3)
+
+        q, k, v = (split(dense(n)(x)) for n in ("query", "key", "value"))
+        if self.mesh is not None and dict(
+                zip(self.mesh.axis_names, self.mesh.devices.shape)
+                ).get("seq", 1) > 1:
+            # context parallelism: ring attention over the seq axis; the pad
+            # mask rides the ring with K/V so padded keys are excluded
+            # exactly as in the dense path.
+            out = att.ring_attention_sharded(q, k, v, self.mesh,
+                                             kv_mask=pad_mask)
+        else:
+            bias = jnp.where(pad_mask[:, None, None, :], 0.0, -jnp.inf)
+            out = att.dense_attention(q, k, v, bias=bias)
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1],
+                                                cfg.hidden)
+        out = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="attn_out")(out)
+        out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+        return out
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+    mesh: Optional[Mesh]
+
+    @nn.compact
+    def __call__(self, x, pad_mask, deterministic: bool):
+        cfg = self.cfg
+        a = SelfAttention(cfg, self.mesh, name="attention")(
+            x, pad_mask, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + a)
+        h = nn.Dense(cfg.intermediate, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_in")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlp_out")(h)
+        h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+
+
+class BertMLM(nn.Module):
+    """Encoder + MLM head (decoder tied to the token embedding)."""
+
+    cfg: BertConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, input_ids, segment_ids, pad_mask, *,
+                 deterministic: bool = True):
+        cfg = self.cfg
+        tok = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="token_embed")
+        pos = nn.Embed(cfg.max_positions, cfg.hidden, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="pos_embed")
+        seg = nn.Embed(cfg.type_vocab, cfg.hidden, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="seg_embed")
+        t = input_ids.shape[1]
+        x = (tok(input_ids) + pos(jnp.arange(t)[None, :]) + seg(segment_ids))
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_embed")(x)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        for i in range(cfg.layers):
+            x = EncoderLayer(cfg, self.mesh, name=f"layer_{i}")(
+                x, pad_mask, deterministic)
+        # MLM head: dense+gelu+LN then tied decode (embedding^T) + bias.
+        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlm_dense")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlm")(h)
+        embedding = tok.variables["params"]["embedding"]
+        logits = jnp.einsum("bth,vh->btv", h.astype(jnp.float32),
+                            embedding.astype(jnp.float32))
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
+        return logits
+
+
+def make_init(cfg: BertConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
+    if seq_len > cfg.max_positions:
+        raise ValueError(
+            f"seq_len={seq_len} exceeds max_positions={cfg.max_positions} "
+            "(XLA would silently clamp position-embedding lookups)")
+    model = BertMLM(cfg, mesh)
+    # init traces through the model (incl. the SP shard_map, whose batch must
+    # divide the data axis), so the dummy batch matches the mesh data size.
+    b = 1
+    if mesh is not None:
+        b = dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+
+    def init_fn(rng):
+        ids = jnp.zeros((b, seq_len), jnp.int32)
+        return model.init(rng, ids, ids, jnp.ones((b, seq_len), bool),
+                          deterministic=True)
+
+    return model, init_fn
+
+
+def make_loss(model: BertMLM):
+    """MLM loss: CE over masked positions (labels==-100 elsewhere)."""
+
+    def loss_fn(params, extra, batch, rng):
+        logits = model.apply(
+            {"params": params}, batch["input_ids"], batch["segment_ids"],
+            batch["attention_mask"].astype(bool),
+            deterministic=model.cfg.dropout == 0.0,
+            rngs={"dropout": rng} if model.cfg.dropout else {})
+        loss, n = softmax_cross_entropy(logits, batch["mlm_labels"],
+                                        ignore_index=-100)
+        # weight=n: grad-accum combines microbatches by valid-position count,
+        # matching the full-batch per-position mean exactly.
+        return loss, LossAux(extra=extra, metrics={"mlm_positions": n},
+                             weight=n)
+
+    return loss_fn

@@ -1,0 +1,177 @@
+"""ResNet-20 (CIFAR) and ResNet-50 (ImageNet) — BASELINE configs 2 and 3.
+
+Reference capability replaced: the CIFAR config ran under
+``MultiWorkerMirroredStrategy`` + NCCL ring all-reduce (SURVEY.md §3.5); the
+ImageNet ResNet-50 row is the north-star metric. Both collapse to the shared
+pjit'd train step — the all-reduce is the same mean-gradient XLA collective.
+
+TPU-first choices:
+- compute in bfloat16 (MXU-native), params and BN statistics in float32;
+- NHWC layout (XLA TPU's preferred conv layout);
+- BatchNorm without ``axis_name``: under GSPMD the batch mean over a
+  data-sharded batch *is* the global mean (XLA inserts the collective), so
+  this is cross-replica sync-BN for free — per-replica BN like the
+  reference's is a behavioral delta documented in README.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from dtf_tpu.core.train import LossAux
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """2×3x3 block (ResNet-18/20/34 family)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="shortcut")(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    """1-3-1 bottleneck (ResNet-50/101/152), v1.5: stride on the 3x3."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(self.norm()(y))
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branch starts as identity
+        # (standard large-batch trick; matters for the MWMS parity config).
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 (self.strides, self.strides),
+                                 name="shortcut")(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef
+    num_classes: int
+    num_filters: int = 64
+    stem: str = "imagenet"  # "imagenet": 7x7/2 + maxpool; "cifar": 3x3/1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, padding="SAME",
+                       dtype=self.dtype, param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        x = x.astype(self.dtype)
+        if self.stem == "imagenet":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="stem_conv")(x)
+            x = nn.relu(norm(name="stem_bn")(x))
+            x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")
+        else:
+            x = conv(self.num_filters, (3, 3), name="stem_conv")(x)
+            x = nn.relu(norm(name="stem_bn")(x))
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                strides = 2 if (i > 0 and j == 0) else 1
+                x = self.block(self.num_filters * 2 ** i, strides,
+                               conv=conv, norm=norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        # classifier head in f32 for numerically stable softmax.
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=jnp.float32, name="head")(x)
+
+
+def resnet20(num_classes: int = 10, dtype=jnp.bfloat16) -> ResNet:
+    """CIFAR ResNet-20: 3 stages × 3 basic blocks, 16 base filters."""
+    return ResNet(stage_sizes=(3, 3, 3), block=BasicBlock,
+                  num_classes=num_classes, num_filters=16, stem="cifar",
+                  dtype=dtype)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    """ImageNet ResNet-50 v1.5 — the north-star benchmark model."""
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock,
+                  num_classes=num_classes, num_filters=64, stem="imagenet",
+                  dtype=dtype)
+
+
+def make_init(model: ResNet, image_shape: tuple[int, ...]):
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1, *image_shape), jnp.float32),
+                          train=False)
+
+    return init_fn
+
+
+def make_loss(model: ResNet, *, weight_decay: float = 0.0,
+              logits_sharding=None):
+    """Cross-entropy (+ optional L2 on kernels) with BN-stat updates.
+
+    ``logits_sharding``: pass a NamedSharding to gather TP-sharded logits
+    before the loss (needed when the head is column-sharded over ``model`` —
+    the class-dim gather in cross-entropy cannot run on a sharded axis; with
+    few classes the all-gather is noise. Large-vocab models use the sharded
+    cross-entropy in :mod:`dtf_tpu.ops` instead.)"""
+
+    def loss_fn(params, extra, batch, rng):
+        logits, new_vars = model.apply(
+            {"params": params, **extra}, batch["image"], train=True,
+            mutable=["batch_stats"])
+        if logits_sharding is not None:
+            logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        if weight_decay:
+            l2 = sum(jnp.sum(jnp.square(p))
+                     for path, p in jax.tree_util.tree_flatten_with_path(
+                         params)[0] if path[-1].key == "kernel")
+            loss = loss + weight_decay * 0.5 * l2
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return loss, LossAux(extra=dict(new_vars),
+                             metrics={"accuracy": acc})
+
+    return loss_fn
+
+
+def make_eval(model: ResNet):
+    def eval_fn(params, extra, batch):
+        logits = model.apply({"params": params, **extra}, batch["image"],
+                             train=False)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["label"]).mean()
+        acc = jnp.mean(jnp.argmax(logits, -1) == batch["label"])
+        return {"eval_loss": loss, "eval_accuracy": acc}
+
+    return eval_fn

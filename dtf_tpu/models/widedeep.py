@@ -1,0 +1,90 @@
+"""Wide&Deep recsys — BASELINE config 5 (row-sharded embedding tables).
+
+Criteo-style CTR model: 13 dense + 26 categorical features.
+
+- **wide**: per-bucket scalar weights (an embedding of dim 1) summed with a
+  linear term on the dense features — the classic cross/linear half.
+- **deep**: per-feature embeddings (row-sharded tables via
+  :class:`dtf_tpu.parallel.embedding.RowShardedEmbed`) concatenated with the
+  dense features into an MLP.
+
+The reference-era version of this put every embedding table on a parameter
+server and paid a gRPC gather per lookup (SURVEY.md §2c "Embedding sharding");
+here tables are GSPMD row-sharded over ``model`` and lookups compile to local
+gathers + one collective.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+from dtf_tpu.core.train import LossAux
+from dtf_tpu.parallel.embedding import RowShardedEmbed, embedding_rules
+
+
+class WideDeep(nn.Module):
+    num_sparse: int = 26
+    hash_buckets: int = 1000
+    embed_dim: int = 16
+    mlp: tuple[int, ...] = (256, 128, 64)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, dense, sparse):
+        # ---- wide: scalar weight per (feature, bucket) + linear on dense.
+        wide_tables = RowShardedEmbed(
+            self.num_sparse * self.hash_buckets, 1, dtype=jnp.float32,
+            name="embed_tables_wide")
+        offsets = jnp.arange(self.num_sparse) * self.hash_buckets
+        flat_ids = sparse + offsets[None, :]          # disjoint id spaces
+        wide_logit = wide_tables(flat_ids)[..., 0].sum(-1)
+        wide_logit = wide_logit + nn.Dense(
+            1, dtype=jnp.float32, param_dtype=jnp.float32,
+            name="wide_dense")(dense)[..., 0]
+
+        # ---- deep: shared-space embeddings → MLP.
+        deep_tables = RowShardedEmbed(
+            self.num_sparse * self.hash_buckets, self.embed_dim,
+            dtype=self.dtype, name="embed_tables_deep")
+        emb = deep_tables(flat_ids)                   # [B, F, E]
+        x = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1),
+             dense.astype(self.dtype)], axis=-1)
+        for i, h in enumerate(self.mlp):
+            x = nn.relu(nn.Dense(h, dtype=self.dtype,
+                                 param_dtype=jnp.float32,
+                                 name=f"mlp_{i}")(x))
+        deep_logit = nn.Dense(1, dtype=jnp.float32, param_dtype=jnp.float32,
+                              name="deep_out")(x)[..., 0]
+        return wide_logit + deep_logit
+
+
+#: model-axis row sharding for both table sets.
+rules = embedding_rules("model")
+
+
+def make_init(model: WideDeep):
+    def init_fn(rng):
+        return model.init(rng, jnp.zeros((1, 13), jnp.float32),
+                          jnp.zeros((1, model.num_sparse), jnp.int32))
+
+    return init_fn
+
+
+def make_loss(model: WideDeep):
+    def loss_fn(params, extra, batch, rng):
+        logits = model.apply({"params": params}, batch["dense"],
+                             batch["sparse"])
+        loss = optax.sigmoid_binary_cross_entropy(
+            logits, batch["label"]).mean()
+        acc = jnp.mean((logits > 0) == (batch["label"] > 0.5))
+        auc_proxy = jnp.corrcoef(jax.nn.sigmoid(logits),
+                                 batch["label"])[0, 1]
+        return loss, LossAux(extra=extra,
+                             metrics={"accuracy": acc,
+                                      "pred_corr": auc_proxy})
+
+    return loss_fn

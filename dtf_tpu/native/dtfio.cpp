@@ -1,0 +1,223 @@
+// dtfio — native data-loading runtime for dtf_tpu.
+//
+// TPU-native successor of the reference's C++ input machinery (SURVEY.md §2b
+// N7): TF's FIFOQueue kernels + queue runners fed the session from C++
+// threads; here a small C library does the host-side heavy lifting — mmap'd
+// IDX parsing, per-epoch Fisher-Yates shuffling, u8→f32 normalization, batch
+// gather — on a background prefetch thread with a double buffer, so Python
+// only ever memcpy's a ready batch while the TPU computes.
+//
+// C ABI only (consumed via ctypes from dtf_tpu/data/native.py). No JAX/TF
+// headers; the contract is plain arrays.
+//
+// Build: make -C dtf_tpu/native   (g++ -O3 -shared -fPIC -pthread)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <mutex>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// IDX container parsing (big-endian header, u8 payload), mmap'd read-only.
+// ---------------------------------------------------------------------------
+
+struct IdxFile {
+  int fd = -1;
+  const uint8_t* map = nullptr;
+  size_t map_len = 0;
+  const uint8_t* data = nullptr;  // payload start
+  std::vector<uint32_t> dims;
+  size_t items = 0;      // dims[0]
+  size_t item_size = 0;  // product of dims[1:]
+
+  bool open(const char* path) {
+    fd = ::open(path, O_RDONLY);
+    if (fd < 0) return false;
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size < 4) return false;
+    map_len = static_cast<size_t>(st.st_size);
+    map = static_cast<const uint8_t*>(
+        mmap(nullptr, map_len, PROT_READ, MAP_PRIVATE, fd, 0));
+    if (map == MAP_FAILED) { map = nullptr; return false; }
+    // magic: 0x00 0x00 dtype ndim ; only u8 (0x08) supported.
+    if (map[0] != 0 || map[1] != 0 || map[2] != 0x08) return false;
+    const unsigned ndim = map[3];
+    if (map_len < 4 + 4ul * ndim) return false;
+    dims.resize(ndim);
+    size_t total = 1;
+    for (unsigned i = 0; i < ndim; ++i) {
+      const uint8_t* p = map + 4 + 4 * i;
+      dims[i] = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+      total *= dims[i];
+    }
+    if (map_len < 4 + 4ul * ndim + total) return false;
+    data = map + 4 + 4 * ndim;
+    items = ndim ? dims[0] : 0;
+    item_size = items ? total / items : 0;
+    return true;
+  }
+
+  void close() {
+    if (map) munmap(const_cast<uint8_t*>(map), map_len);
+    if (fd >= 0) ::close(fd);
+    map = nullptr; fd = -1;
+  }
+};
+
+// splitmix64 — deterministic, seedable, platform-independent shuffling.
+static inline uint64_t splitmix64(uint64_t& s) {
+  uint64_t z = (s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+struct Loader {
+  IdxFile images, labels;
+  size_t batch = 0;
+  uint64_t seed = 0;
+  size_t host_index = 0, host_count = 1;
+
+  // epoch state (owned by the prefetch thread)
+  std::vector<uint32_t> order;   // this host's shard of the epoch permutation
+  size_t cursor = 0;
+  uint64_t epoch = 0;
+
+  // double buffer
+  std::vector<float> buf_images[2];
+  std::vector<int32_t> buf_labels[2];
+  int ready_slot = -1;           // filled slot index, -1 = none
+  bool stop = false;
+  std::mutex mu;
+  std::condition_variable cv_ready, cv_taken;
+  std::thread worker;
+
+  void reshuffle() {
+    const size_t n = images.items;
+    std::vector<uint32_t> perm(n);
+    for (size_t i = 0; i < n; ++i) perm[i] = uint32_t(i);
+    uint64_t s = seed * 0x9e3779b97f4a7c15ull + epoch + 1;
+    for (size_t i = n - 1; i > 0; --i) {
+      size_t j = splitmix64(s) % (i + 1);
+      std::swap(perm[i], perm[j]);
+    }
+    order.clear();
+    for (size_t i = host_index; i < n; i += host_count)
+      order.push_back(perm[i]);
+    cursor = 0;
+  }
+
+  void fill(int slot) {
+    const size_t isz = images.item_size;
+    float* out = buf_images[slot].data();
+    int32_t* lab = buf_labels[slot].data();
+    for (size_t b = 0; b < batch; ++b) {
+      if (cursor >= order.size()) {  // epoch boundary: batches may span it
+        ++epoch;
+        reshuffle();
+      }
+      const uint32_t idx = order[cursor++];
+      const uint8_t* src = images.data + size_t(idx) * isz;
+      float* dst = out + b * isz;
+      constexpr float kScale = 1.0f / 255.0f;
+      for (size_t i = 0; i < isz; ++i) dst[i] = src[i] * kScale;
+      lab[b] = labels.data[idx];
+    }
+  }
+
+  void run() {
+    int slot = 0;
+    while (true) {
+      fill(slot);  // compute outside the lock
+      {
+        std::unique_lock<std::mutex> l(mu);
+        cv_taken.wait(l, [&] { return ready_slot == -1 || stop; });
+        if (stop) return;
+        ready_slot = slot;
+      }
+      cv_ready.notify_one();
+      slot ^= 1;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Returns an opaque handle or nullptr. Batch is the HOST-LOCAL batch size.
+void* dtfio_loader_create(const char* images_path, const char* labels_path,
+                          size_t batch, uint64_t seed, size_t host_index,
+                          size_t host_count) {
+  auto* L = new Loader();
+  if (!L->images.open(images_path) || !L->labels.open(labels_path) ||
+      L->images.items == 0 || L->images.items != L->labels.items ||
+      L->labels.item_size != 1 ||  // labels must be a 1-D idx1 file
+      batch == 0 || host_count == 0 || host_index >= host_count ||
+      L->images.items / host_count < batch) {
+    L->images.close(); L->labels.close(); delete L;
+    return nullptr;
+  }
+  L->batch = batch; L->seed = seed;
+  L->host_index = host_index; L->host_count = host_count;
+  for (int s = 0; s < 2; ++s) {
+    L->buf_images[s].resize(batch * L->images.item_size);
+    L->buf_labels[s].resize(batch);
+  }
+  L->reshuffle();
+  L->worker = std::thread([L] { L->run(); });
+  return L;
+}
+
+size_t dtfio_item_size(void* handle) {
+  return static_cast<Loader*>(handle)->images.item_size;
+}
+
+size_t dtfio_num_items(void* handle) {
+  return static_cast<Loader*>(handle)->images.items;
+}
+
+// Blocks until the prefetched batch is ready, copies it out, and wakes the
+// prefetch thread to fill the next one. images_out: batch*item_size floats;
+// labels_out: batch int32.
+void dtfio_loader_next(void* handle, float* images_out, int32_t* labels_out) {
+  auto* L = static_cast<Loader*>(handle);
+  int slot;
+  {
+    std::unique_lock<std::mutex> l(L->mu);
+    L->cv_ready.wait(l, [&] { return L->ready_slot != -1; });
+    slot = L->ready_slot;
+    std::memcpy(images_out, L->buf_images[slot].data(),
+                L->buf_images[slot].size() * sizeof(float));
+    std::memcpy(labels_out, L->buf_labels[slot].data(),
+                L->buf_labels[slot].size() * sizeof(int32_t));
+    L->ready_slot = -1;
+  }
+  L->cv_taken.notify_one();
+}
+
+void dtfio_loader_destroy(void* handle) {
+  auto* L = static_cast<Loader*>(handle);
+  {
+    std::lock_guard<std::mutex> l(L->mu);
+    L->stop = true;
+  }
+  L->cv_taken.notify_all();
+  if (L->worker.joinable()) L->worker.join();
+  L->images.close();
+  L->labels.close();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,1 @@
+"""TPU-first ops: attention (dense + ring), sharded losses, Pallas kernels."""

@@ -1,0 +1,152 @@
+"""Attention ops: dense reference MHA and ring attention (context parallelism).
+
+The reference has nothing here (SURVEY.md §5.7 — nothing in it scales
+sequence length), but long-context is first-class in this framework: ring
+attention shards the sequence over the ``seq`` mesh axis and streams K/V
+blocks around the ring with ``ppermute`` (one ICI hop per step), using an
+online-softmax accumulator so memory stays O(seq/shards) per device. The
+blockwise math follows the public ring-attention recipe (Liu et al.;
+flash-attention-style streaming max/sum rescaling).
+
+Layouts: [batch, heads, seq, head_dim] (B H T D). Softmax statistics
+accumulate in float32 regardless of input dtype (bf16-safe).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
+                    bias: Optional[jax.Array] = None,
+                    sm_scale: Optional[float] = None) -> jax.Array:
+    """Reference O(T²) attention. [B,H,T,D] → [B,H,T,D]; f32 softmax."""
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    if causal:
+        q_pos = jnp.arange(t_q)[:, None]
+        k_pos = jnp.arange(t_k)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
+
+
+def _ring_block(scores: jax.Array, v_blk: jax.Array, m: jax.Array,
+                l: jax.Array, o: jax.Array):
+    """Online-softmax update with one incoming score block (f32 stats).
+
+    Fully-masked rows (all scores -inf so far — e.g. a pad query, or a causal
+    query before its diagonal block arrives) are handled by ``safe_m``: their
+    running max stays -inf, alpha and p collapse to 0, and l/o stay 0.
+    """
+    m_blk = scores.max(-1)                                   # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(jnp.minimum(m - safe_m, 0.0))            # -inf → 0
+    p = jnp.exp(scores - safe_m[..., None])                  # -inf → 0
+    l_new = l * alpha + p.sum(-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   kv_mask: Optional[jax.Array] = None, *,
+                   axis_name: str = "seq", causal: bool = False,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Ring attention over a sequence-sharded mesh axis (call inside shard_map).
+
+    Per-shard shapes [B,H,Tl,D] where Tl = T/num_shards; shard i holds global
+    positions [i*Tl, (i+1)*Tl). The local K/V block is processed in place;
+    each of the n-1 ring steps then receives a neighbor's block (ppermute →
+    one ICI hop) and folds it into a streaming-softmax accumulator — compute
+    and ICI transfer overlap under XLA's async collective scheduling.
+
+    ``kv_mask`` [B,Tl] (True = valid key) travels the ring alongside K/V, so
+    padded positions are excluded exactly as in dense attention. Causal
+    masking uses global positions; future blocks contribute nothing. Compute
+    for fully-masked blocks is not skipped in this v1 — a latency note, not a
+    correctness one.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, t_l, d = q.shape
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    if kv_mask is None:
+        kv_mask = (q[:, 0, :, 0] * 0 + 1).astype(bool)        # [B,Tl], varying
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = idx * t_l + jnp.arange(t_l)                       # global q rows
+
+    def fold(k_blk, v_blk, mask_blk, src, m, l, o):
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
+                            preferred_element_type=jnp.float32) * scale
+        valid = mask_blk[:, None, None, :]                    # [B,1,1,Tk]
+        if causal:
+            k_pos = src * t_l + jnp.arange(t_l)
+            valid = valid & (q_pos[:, None] >= k_pos[None, :])
+        scores = jnp.where(valid, scores, -jnp.inf)
+        return _ring_block(scores, v_blk, m, l, o)
+
+    # derive carries from q so they inherit its varying-manual-axes type
+    # (shard_map's vma checker rejects unvarying init carries).
+    zeros_q = q.astype(jnp.float32) * 0.0                     # [B,H,Tl,D]
+    m0, l0, o0 = zeros_q[..., 0] - jnp.inf, zeros_q[..., 0], zeros_q
+
+    # local block first, then n-1 ring steps (no dead final transfer).
+    m, l, o = fold(k, v, kv_mask, idx, m0, l0, o0)
+
+    def body(carry, step):
+        k_blk, v_blk, mask_blk, m, l, o = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
+        src = (idx - step) % n
+        m, l, o = fold(k_blk, v_blk, mask_blk, src, m, l, o)
+        return (k_blk, v_blk, mask_blk, m, l, o), None
+
+    if n > 1:
+        (_, _, _, m, l, o), _ = jax.lax.scan(
+            body, (k, v, kv_mask, m, l, o), jnp.arange(1, n))
+    # l=0 rows are fully-masked (pad queries): output 0, excluded from loss.
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, kv_mask: Optional[jax.Array] = None,
+                           *, causal: bool = False,
+                           sm_scale: Optional[float] = None) -> jax.Array:
+    """Global-array wrapper: shard_map(ring_attention) over the mesh.
+
+    Expects [B,H,T,D] with B on ``data``, H on ``model``, T on ``seq``;
+    ``kv_mask`` [B,T] (True = valid key) sharded like the sequence. Falls
+    back to dense attention when the seq axis is trivial (the shard_map
+    would just add partitioning noise).
+    """
+    seq_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1)
+    if seq_shards == 1:
+        bias = None
+        if kv_mask is not None:
+            bias = jnp.where(kv_mask[:, None, None, :], 0.0, -jnp.inf)
+        return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                               bias=bias)
+    spec = P("data", "model", "seq", None)
+    mask_spec = P("data", "seq")
+    fn = functools.partial(ring_attention, causal=causal, sm_scale=sm_scale)
+    if kv_mask is None:
+        kv_mask = jnp.ones(q.shape[:1] + q.shape[2:3], bool)
+    return jax.shard_map(fn, mesh=mesh,
+                         in_specs=(spec, spec, spec, mask_spec),
+                         out_specs=spec)(q, k, v, kv_mask)

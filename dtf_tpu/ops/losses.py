@@ -1,0 +1,38 @@
+"""GSPMD-friendly losses for sharded logits (TP vocab sharding).
+
+``take_along_axis`` on a vocab-sharded class dim is a sharded gather —
+ambiguous/expensive under GSPMD. The one-hot contraction form keeps the
+whole loss as matmul/reduce ops the partitioner handles natively (the psum
+over the vocab shards is inserted automatically), which is how large-vocab
+MLM heads stay TP-sharded end-to-end.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                          *, ignore_index: int | None = None,
+                          ) -> tuple[jax.Array, jax.Array]:
+    """Per-example CE for integer labels via one-hot contraction.
+
+    logits [..., V] (V may be mesh-sharded), labels [...] int. Returns
+    (mean_loss, valid_count). With ``ignore_index`` (e.g. -100 for unmasked
+    MLM positions), ignored positions contribute 0 and the mean is over valid
+    positions only (psum-safe: both numerator and denominator are reductions).
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    valid = (labels != ignore_index) if ignore_index is not None else None
+    safe_labels = jnp.where(valid, labels, 0) if valid is not None else labels
+    one_hot = jax.nn.one_hot(safe_labels, logits.shape[-1],
+                             dtype=logits.dtype)
+    picked = jnp.sum(one_hot * logits, axis=-1)
+    ce = lse - picked
+    if valid is None:
+        return ce.mean(), jnp.asarray(ce.size, jnp.float32)
+    ce = jnp.where(valid, ce, 0.0)
+    n = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
+    return ce.sum() / n, n

@@ -1,0 +1,15 @@
+"""Parallelism strategies (SURVEY.md §2c inventory).
+
+- **DP (sync)** — the default train step: batch over ``data``, mean-gradient
+  all-reduce (:mod:`dtf_tpu.core.train`).
+- **ZeRO-1** — optimizer-state sharding over ``data``
+  (:func:`dtf_tpu.core.sharding.zero1_opt_specs`).
+- **TP** — Megatron-style rules over ``model``
+  (e.g. :data:`dtf_tpu.models.bert.tp_rules`).
+- **SP/CP** — ring attention over ``seq``
+  (:mod:`dtf_tpu.ops.attention`).
+- **Embedding sharding** — PS-round-robin successor: row-sharded tables
+  (:mod:`dtf_tpu.parallel.embedding`).
+- **DP (async)** — not reproduced: hogwild PS updates are an anti-pattern on
+  TPU; ``--issync=0`` warns and runs synchronously (behavioral delta).
+"""

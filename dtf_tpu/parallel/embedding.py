@@ -1,0 +1,84 @@
+"""Row-sharded embedding tables — successor of PS-sharded embeddings.
+
+Reference capability replaced (SURVEY.md §2c, BASELINE config 5): the
+reference round-robins embedding variables across parameter servers via
+``replica_device_setter`` (TF ``device_setter.py`` ``_RoundRobinStrategy``)
+and every lookup is a remote gather over gRPC. Here tables are row-sharded
+over a mesh axis (``NamedSharding(P(axis, None))``) and lookups stay
+on-device:
+
+- **GSPMD path** (default): plain ``take`` — the partitioner turns a gather
+  on a row-sharded table into local gathers + collectives automatically.
+- **Explicit path** (:func:`masked_lookup_sharded`): shard_map with a local
+  masked lookup + ``psum`` — each shard serves only ids in its row range and
+  contributes zeros elsewhere. One ICI all-reduce of [batch, dim], no table
+  replication anywhere; this is the shape a Pallas kernel would optimize.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def masked_lookup(table_shard: jax.Array, ids: jax.Array,
+                  axis_name: str) -> jax.Array:
+    """Per-shard body: lookup ids that land in this shard's rows, psum.
+
+    ``table_shard`` [rows/n, dim]; ``ids`` [...] global row indices
+    (replicated across the axis). Returns [..., dim] fully-reduced.
+    """
+    n_local = table_shard.shape[0]
+    start = jax.lax.axis_index(axis_name) * n_local
+    local = ids - start
+    in_range = (local >= 0) & (local < n_local)
+    safe = jnp.clip(local, 0, n_local - 1)
+    rows = jnp.take(table_shard, safe, axis=0)
+    rows = jnp.where(in_range[..., None], rows, 0)
+    return jax.lax.psum(rows, axis_name)
+
+
+def masked_lookup_sharded(table: jax.Array, ids: jax.Array, mesh: Mesh,
+                          *, axis: str = "model",
+                          ids_spec: P = P("data")) -> jax.Array:
+    """Global-array wrapper over :func:`masked_lookup`.
+
+    ``table`` row-sharded over ``axis``; ``ids`` sharded over ``data``.
+    """
+    fn = functools.partial(masked_lookup, axis_name=axis)
+    out_spec = P(*ids_spec, *([None] * 1))
+    return jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(P(axis, None), ids_spec),
+        out_specs=out_spec)(table, ids)
+
+
+class RowShardedEmbed(nn.Module):
+    """Embedding table intended for ``P(axis, None)`` row sharding.
+
+    The module itself is plain flax (placement comes from the param rules —
+    same philosophy as the reference's device_setter wrapping the model); the
+    name ``embed_tables`` is what the rule regexes target.
+    """
+
+    num_embeddings: int
+    features: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids):
+        table = self.param(
+            "embedding",
+            nn.initializers.variance_scaling(1.0, "fan_in", "normal",
+                                             out_axis=0),
+            (self.num_embeddings, self.features), jnp.float32)
+        return jnp.take(table.astype(self.dtype), ids, axis=0)
+
+
+#: Placement rule for all RowShardedEmbed tables in a model.
+def embedding_rules(axis: str = "model"):
+    return [(r"embed_tables.*/embedding", P(axis, None))]

@@ -61,9 +61,20 @@ def main(argv):
                               shardings, grad_accum=FLAGS.grad_accum)
 
     if FLAGS.data_dir and mnist_data.available(FLAGS.data_dir):
-        data = mnist_data.MnistData(
-            FLAGS.data_dir, FLAGS.batch_size, seed=FLAGS.seed,
-            host_index=info.process_id, host_count=info.num_processes)
+        from dtf_tpu.data import native as native_io
+
+        img = os.path.join(FLAGS.data_dir, mnist_data.FILES["train_images"])
+        lab = os.path.join(FLAGS.data_dir, mnist_data.FILES["train_labels"])
+        if native_io.native_available() and os.path.exists(img) \
+                and os.path.exists(lab):
+            # C++ prefetching loader (queue-runner successor)
+            data = native_io.NativeIdxData(
+                img, lab, FLAGS.batch_size, seed=FLAGS.seed,
+                host_index=info.process_id, host_count=info.num_processes)
+        else:
+            data = mnist_data.MnistData(
+                FLAGS.data_dir, FLAGS.batch_size, seed=FLAGS.seed,
+                host_index=info.process_id, host_count=info.num_processes)
     else:
         if FLAGS.data_dir:
             absl_logging.warning("MNIST files not found in %s; using "

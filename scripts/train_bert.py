@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""BERT-base MLM pretraining — BASELINE config 4 (grad-accum + ZeRO-1).
+
+    python scripts/train_bert.py --grad_accum=4 --mesh_model=2 --mesh_seq=2
+
+Parallelism is fully flag-driven: dp over `data` (ZeRO-1 shards optimizer
+state there), TP over `model` (Megatron rules), context parallelism over
+`seq` (ring attention).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from dtf_tpu.cli import flags as dflags
+
+dflags.define_cluster_flags()
+dflags.define_mesh_flags()
+dflags.define_train_flags(batch_size=64, learning_rate=1e-4, train_steps=200)
+flags.DEFINE_integer("seq_len", 128, "sequence length")
+flags.DEFINE_string("size", "base", "base | tiny")
+flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    import jax
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from dtf_tpu.checkpoint import Checkpointer
+    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.comms import batch_shardings_for
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
+    from dtf_tpu.loop import Trainer
+    from dtf_tpu.metrics import MetricWriter
+    from dtf_tpu.models import bert
+
+    mesh, info = setup(FLAGS)
+    sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1) > 1
+
+    cfg = (bert.BertConfig.base() if FLAGS.size == "base"
+           else bert.BertConfig.tiny())
+    model, init_fn = bert.make_init(cfg, mesh if sp else None,
+                                    seq_len=FLAGS.seq_len)
+    tx = optax.adamw(
+        optax.warmup_cosine_decay_schedule(
+            0.0, FLAGS.learning_rate,
+            min(1000, FLAGS.train_steps // 10 + 1), FLAGS.train_steps),
+        weight_decay=0.01)
+    state, shardings = tr.create_train_state(
+        init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
+        param_rules=bert.tp_rules, zero1=FLAGS.zero1)
+
+    data = SyntheticData("bert", FLAGS.batch_size, seed=FLAGS.seed,
+                         seq_len=FLAGS.seq_len, vocab_size=cfg.vocab_size,
+                         host_index=info.process_id,
+                         host_count=info.num_processes)
+    kwargs = {}
+    spec = None
+    if sp:
+        spec = P("data", "seq")
+        kwargs["batch_shardings"] = batch_shardings_for(
+            data.batch(0), mesh, spec)
+    step = tr.make_train_step(bert.make_loss(model), tx, mesh, shardings,
+                              grad_accum=FLAGS.grad_accum, **kwargs)
+
+    from dtf_tpu.core.comms import shard_batch
+
+    writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
+    ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
+                        save_interval_steps=FLAGS.checkpoint_every)
+    trainer = Trainer(
+        step, mesh,
+        hooks=[LoggingHook(writer, FLAGS.log_every),
+               CheckpointHook(ckpt, FLAGS.checkpoint_every),
+               StopAtStepHook(FLAGS.train_steps)],
+        checkpointer=ckpt,
+        place_batch=lambda b: shard_batch(b, mesh, spec=spec))
+    state = trainer.fit(state, iter(data))
+    writer.close()
+    ckpt.close()
+    print(f"done: step={int(state.step)}")
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""ResNet training: CIFAR-10 ResNet-20 (BASELINE config 2, the MWMS+NCCL row)
+or ImageNet ResNet-50 (config 3, the north-star metric).
+
+    python scripts/train_resnet.py --config=cifar    # ResNet-20
+    python scripts/train_resnet.py --config=imagenet # ResNet-50
+
+Same cluster flags as the reference scripts; the MultiWorkerMirroredStrategy
+collective path is the same compiled mean-gradient all-reduce (SURVEY.md §3.5
+maps MWMS 1:1 onto the psum path).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from dtf_tpu.cli import flags as dflags
+
+dflags.define_cluster_flags()
+dflags.define_mesh_flags()
+dflags.define_train_flags(batch_size=256, learning_rate=0.1, train_steps=500)
+flags.DEFINE_string("config", "cifar", "cifar (ResNet-20) | imagenet "
+                    "(ResNet-50)")
+flags.DEFINE_float("weight_decay", 1e-4, "L2 on conv/dense kernels")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    import jax
+    import optax
+
+    from dtf_tpu.checkpoint import Checkpointer
+    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
+    from dtf_tpu.loop import Trainer
+    from dtf_tpu.metrics import MetricWriter
+    from dtf_tpu.models import resnet
+
+    mesh, info = setup(FLAGS)
+
+    if FLAGS.config == "cifar":
+        model, shape, kind = resnet.resnet20(), (32, 32, 3), "cifar"
+    else:
+        model, shape, kind = resnet.resnet50(), (224, 224, 3), "imagenet"
+
+    steps_total = FLAGS.train_steps
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, FLAGS.learning_rate, min(500, steps_total // 10 + 1),
+        steps_total)
+    tx = optax.sgd(sched, momentum=0.9, nesterov=True)
+    state, shardings = tr.create_train_state(
+        resnet.make_init(model, shape), tx, jax.random.PRNGKey(FLAGS.seed),
+        mesh)
+    step = tr.make_train_step(
+        resnet.make_loss(model, weight_decay=FLAGS.weight_decay), tx, mesh,
+        shardings, grad_accum=FLAGS.grad_accum)
+
+    data = SyntheticData(kind, FLAGS.batch_size, seed=FLAGS.seed,
+                         host_index=info.process_id,
+                         host_count=info.num_processes)
+
+    writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
+    ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
+                        save_interval_steps=FLAGS.checkpoint_every)
+    trainer = Trainer(
+        step, mesh,
+        hooks=[LoggingHook(writer, FLAGS.log_every),
+               CheckpointHook(ckpt, FLAGS.checkpoint_every),
+               StopAtStepHook(FLAGS.train_steps)],
+        checkpointer=ckpt)
+    state = trainer.fit(state, iter(data))
+    writer.close()
+    ckpt.close()
+    print(f"done: step={int(state.step)}")
+
+
+if __name__ == "__main__":
+    app.run(main)

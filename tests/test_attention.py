@@ -1,0 +1,125 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dtf_tpu.ops import attention as att
+from dtf_tpu.ops.losses import softmax_cross_entropy
+
+
+def _qkv(b=2, h=4, t=16, d=8, seed=0, dtype=jnp.float32):
+    r = jax.random.PRNGKey(seed)
+    kq, kk, kv = jax.random.split(r, 3)
+    return (jax.random.normal(kq, (b, h, t, d), dtype),
+            jax.random.normal(kk, (b, h, t, d), dtype),
+            jax.random.normal(kv, (b, h, t, d), dtype))
+
+
+def test_dense_attention_matches_naive():
+    q, k, v = _qkv()
+    out = att.dense_attention(q, k, v)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(8)
+    ref = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_dense_causal_masks_future():
+    q, k, v = _qkv()
+    out = att.dense_attention(q, k, v, causal=True)
+    # changing future keys/values must not change earlier outputs
+    k2 = k.at[:, :, 10:].set(99.0)
+    v2 = v.at[:, :, 10:].set(-99.0)
+    out2 = att.dense_attention(q, k2, v2, causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :, :10]),
+                               np.asarray(out2[:, :, :10]), atol=1e-5)
+
+
+def test_ring_attention_matches_dense(mesh8):
+    q, k, v = _qkv(t=32)
+    ref = att.dense_attention(q, k, v)
+    seq_mesh = jax.make_mesh((1, 8, 1), ("data", "seq", "model"),
+                             devices=jax.devices(),
+                             axis_types=(jax.sharding.AxisType.Auto,) * 3)
+    spec = P("data", "model", "seq", None)
+    out = jax.jit(jax.shard_map(
+        att.ring_attention, mesh=seq_mesh,
+        in_specs=(spec, spec, spec), out_specs=spec))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_causal_matches_dense():
+    q, k, v = _qkv(t=32)
+    ref = att.dense_attention(q, k, v, causal=True)
+    seq_mesh = jax.make_mesh((1, 4, 1), ("data", "seq", "model"),
+                             devices=jax.devices()[:4],
+                             axis_types=(jax.sharding.AxisType.Auto,) * 3)
+    spec = P("data", "model", "seq", None)
+    out = jax.jit(jax.shard_map(
+        lambda q, k, v: att.ring_attention(q, k, v, causal=True),
+        mesh=seq_mesh, in_specs=(spec, spec, spec), out_specs=spec))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_bf16_stats_stable():
+    q, k, v = _qkv(t=16, dtype=jnp.bfloat16)
+    ref = att.dense_attention(q, k, v)
+    seq_mesh = jax.make_mesh((1, 4, 1), ("data", "seq", "model"),
+                             devices=jax.devices()[:4],
+                             axis_types=(jax.sharding.AxisType.Auto,) * 3)
+    spec = P("data", "model", "seq", None)
+    out = jax.jit(jax.shard_map(
+        att.ring_attention, mesh=seq_mesh,
+        in_specs=(spec, spec, spec), out_specs=spec))(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32), atol=0.05)
+
+
+def test_ring_attention_pad_mask_matches_dense():
+    # padded keys must be excluded exactly as in the dense masked path
+    q, k, v = _qkv(t=32)
+    mask = jnp.ones((2, 32), bool).at[:, 24:].set(False)  # last 8 padded
+    bias = jnp.where(mask[:, None, None, :], 0.0, -jnp.inf)
+    ref = att.dense_attention(q, k, v, bias=bias)
+    seq_mesh = jax.make_mesh((1, 4, 1), ("data", "seq", "model"),
+                             devices=jax.devices()[:4],
+                             axis_types=(jax.sharding.AxisType.Auto,) * 3)
+    out = att.ring_attention_sharded(q, k, v, seq_mesh, kv_mask=mask)
+    # valid queries match; pad-query rows are defined as 0 in ring mode
+    np.testing.assert_allclose(np.asarray(out[:, :, :24]),
+                               np.asarray(ref[:, :, :24]),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_ring_attention_sharded_wrapper_seq1_falls_back(mesh8):
+    q, k, v = _qkv()
+    out = att.ring_attention_sharded(q, k, v, mesh8)
+    ref = att.dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_sharded_xent_matches_optax():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (6, 11))
+    labels = jnp.asarray([0, 3, 10, 5, 1, 7])
+    ours, n = softmax_cross_entropy(logits, labels)
+    ref = optax.softmax_cross_entropy_with_integer_labels(
+        logits, labels).mean()
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-6)
+    assert float(n) == 6
+
+
+def test_sharded_xent_ignore_index():
+    logits = jax.random.normal(jax.random.PRNGKey(0), (4, 7))
+    labels = jnp.asarray([2, -100, 5, -100])
+    ours, n = softmax_cross_entropy(logits, labels, ignore_index=-100)
+    ref = optax.softmax_cross_entropy_with_integer_labels(
+        logits[jnp.asarray([0, 2])], jnp.asarray([2, 5])).mean()
+    np.testing.assert_allclose(float(ours), float(ref), rtol=1e-6)
+    assert float(n) == 2
+    # all-ignored must not NaN
+    all_ignored, n0 = softmax_cross_entropy(
+        logits, jnp.full((4,), -100), ignore_index=-100)
+    assert float(all_ignored) == 0.0
