@@ -96,7 +96,7 @@ def single_device_mesh(device: jax.Device | None = None) -> Mesh:
 
 
 def mesh_summary(mesh: Mesh) -> str:
-    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sizes = dict(mesh.shape)
     n = math.prod(mesh.devices.shape)
     plat = mesh.devices.flat[0].platform
     return f"mesh[{plat}x{n}] " + " ".join(f"{k}={v}" for k, v in sizes.items())

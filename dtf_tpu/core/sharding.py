@@ -104,7 +104,7 @@ def zero1_opt_specs(tx: optax.GradientTransformation, params: PyTree,
     (step counts) are replicated. This is the successor of the reference's
     PS-resident optimizer slots: state lives sharded instead of remote.
     """
-    data_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(axis, 1)
+    data_size = mesh.shape.get(axis, 1)
     abstract_state = jax.eval_shape(tx.init, params)
 
     def leaf_spec(state_leaf, spec):

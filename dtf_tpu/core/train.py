@@ -194,10 +194,9 @@ def make_train_step(
             metrics = dict(aux.metrics)
             extra = aux.extra
         else:
-            data_size = dict(
-                zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+            data_size = mesh.shape.get("data", 1)
 
-            def to_micro(x):
+            def to_micro(x, sh=None):
                 if x.shape[0] % grad_accum or (
                         x.shape[0] // grad_accum) % data_size:
                     raise ValueError(
@@ -205,15 +204,21 @@ def make_train_step(
                         f"{grad_accum} gives microbatch "
                         f"{x.shape[0] // grad_accum}, which must be divisible "
                         f"by the data axis ({data_size} shards)")
-                # scan (microbatch) axis replicated; per-micro batch dim keeps
-                # the data sharding (constraint guides GSPMD propagation).
+                # scan (microbatch) axis replicated; the remaining dims keep
+                # the leaf's batch sharding (e.g. P('data','seq') token ids
+                # stay seq-sharded — hardcoding None here would all-gather
+                # the sequence and defeat context parallelism).
                 y = x.reshape(
                     (grad_accum, x.shape[0] // grad_accum) + x.shape[1:])
+                spec = tuple(sh.spec) if sh is not None else ("data",)
+                spec = spec + (None,) * (x.ndim - len(spec))
                 return jax.lax.with_sharding_constraint(
-                    y, NamedSharding(
-                        mesh, P(None, "data", *([None] * (x.ndim - 1)))))
+                    y, NamedSharding(mesh, P(None, *spec)))
 
-            micro = jax.tree.map(to_micro, batch)
+            if batch_shardings is None:
+                micro = jax.tree.map(to_micro, batch)
+            else:
+                micro = jax.tree.map(to_micro, batch, batch_shardings)
 
             def body(carry, mb):
                 acc, w_sum, extra, i = carry

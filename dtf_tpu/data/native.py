@@ -53,7 +53,13 @@ def _load():
                 and os.path.getmtime(src) > os.path.getmtime(_SO_PATH)):
             if not _build():
                 return None
-        lib = ctypes.CDLL(_SO_PATH)
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            # A present-but-unloadable .so (stale copy, wrong arch) must take
+            # the documented clean fallback, not crash the availability probe.
+            log.warning("native dtfio load failed: %s", e)
+            return None
         lib.dtfio_loader_create.restype = ctypes.c_void_p
         lib.dtfio_loader_create.argtypes = [
             ctypes.c_char_p, ctypes.c_char_p, ctypes.c_size_t,

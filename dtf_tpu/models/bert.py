@@ -81,9 +81,7 @@ class SelfAttention(nn.Module):
                              d_head).transpose(0, 2, 1, 3)
 
         q, k, v = (split(dense(n)(x)) for n in ("query", "key", "value"))
-        if self.mesh is not None and dict(
-                zip(self.mesh.axis_names, self.mesh.devices.shape)
-                ).get("seq", 1) > 1:
+        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             # context parallelism: ring attention over the seq axis; the pad
             # mask rides the ring with K/V so padded keys are excluded
             # exactly as in the dense path.
@@ -165,7 +163,7 @@ def make_init(cfg: BertConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
     # divide the data axis), so the dummy batch matches the mesh data size.
     b = 1
     if mesh is not None:
-        b = dict(zip(mesh.axis_names, mesh.devices.shape)).get("data", 1)
+        b = mesh.shape.get("data", 1)
 
     def init_fn(rng):
         ids = jnp.zeros((b, seq_len), jnp.int32)

@@ -81,8 +81,10 @@ def make_loss(model: WideDeep):
         loss = optax.sigmoid_binary_cross_entropy(
             logits, batch["label"]).mean()
         acc = jnp.mean((logits > 0) == (batch["label"] > 0.5))
-        auc_proxy = jnp.corrcoef(jax.nn.sigmoid(logits),
-                                 batch["label"])[0, 1]
+        # corrcoef is NaN when labels (or preds) are constant in the batch
+        # (zero std); report 0 correlation instead of poisoning the stream.
+        auc_proxy = jnp.nan_to_num(
+            jnp.corrcoef(jax.nn.sigmoid(logits), batch["label"])[0, 1])
         return loss, LossAux(extra=extra,
                              metrics={"accuracy": acc,
                                       "pred_corr": auc_proxy})

@@ -38,7 +38,12 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         q_pos = jnp.arange(t_q)[:, None]
         k_pos = jnp.arange(t_k)[None, :]
         scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
+    # Fully-masked rows (e.g. an all-padding sequence) would softmax over
+    # all--inf and yield NaN; force them to 0 output with a grad-safe where
+    # (matches the ring path's l=0 handling).
+    dead = jnp.all(jnp.isneginf(scores), axis=-1, keepdims=True)
+    probs = jax.nn.softmax(jnp.where(dead, 0.0, scores), axis=-1)
+    probs = jnp.where(dead, 0.0, probs)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
@@ -135,7 +140,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     back to dense attention when the seq axis is trivial (the shard_map
     would just add partitioning noise).
     """
-    seq_shards = dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1)
+    seq_shards = mesh.shape.get("seq", 1)
     if seq_shards == 1:
         bias = None
         if kv_mask is not None:

@@ -43,7 +43,7 @@ def main(argv):
     from dtf_tpu.models import bert
 
     mesh, info = setup(FLAGS)
-    sp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("seq", 1) > 1
+    sp = mesh.shape.get("seq", 1) > 1
 
     cfg = (bert.BertConfig.base() if FLAGS.size == "base"
            else bert.BertConfig.tiny())
