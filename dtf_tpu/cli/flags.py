@@ -36,6 +36,8 @@ def define_mesh_flags():
                          "remaining devices)")
     flags.DEFINE_integer("mesh_seq", 1, "sequence/context-parallel axis size")
     flags.DEFINE_integer("mesh_model", 1, "tensor-parallel axis size")
+    flags.DEFINE_integer("mesh_pipe", 1, "pipeline-parallel axis size")
+    flags.DEFINE_integer("mesh_expert", 1, "expert-parallel (MoE) axis size")
 
 
 def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000):

@@ -45,8 +45,9 @@ def setup(FLAGS):
         # Local-sim path: the test/dev equivalent of a multi-worker cluster.
         jax.config.update("jax_platforms", "cpu")
     dist.initialize(info)
-    mesh = make_mesh(MeshConfig(data=FLAGS.mesh_data, seq=FLAGS.mesh_seq,
-                                model=FLAGS.mesh_model))
+    mesh = make_mesh(MeshConfig(
+        data=FLAGS.mesh_data, seq=FLAGS.mesh_seq, model=FLAGS.mesh_model,
+        pipe=FLAGS.mesh_pipe, expert=FLAGS.mesh_expert))
     if info.is_chief:
         log.info("%s | %d process(es), chief=%s",
                  mesh_summary(mesh), info.num_processes, info.is_chief)

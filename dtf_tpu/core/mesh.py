@@ -16,6 +16,10 @@ Axis convention (sizes of 1 are allowed and common):
 - ``seq``   — sequence/context parallelism (ring attention over ICI neighbors).
 - ``model`` — tensor parallelism (Megatron-style column/row sharding) and
   row-sharded embedding tables (the PS-sharded-embedding successor).
+- ``pipe``  — pipeline parallelism (stage-stacked params; GPipe microbatch
+  schedule inside shard_map — see ``dtf_tpu.parallel.pipeline``).
+- ``expert`` — expert parallelism (MoE expert-sharded FFN weights; token
+  dispatch rides XLA all-to-all — see ``dtf_tpu.parallel.moe``).
 """
 
 from __future__ import annotations
@@ -30,11 +34,15 @@ from jax.sharding import Mesh
 AXIS_DATA = "data"
 AXIS_SEQ = "seq"
 AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_EXPERT = "expert"
 #: Canonical mesh axis order. data is the slowest-varying axis so that the
 #: model/seq axes land on adjacent devices (best ICI locality for the
 #: high-traffic TP/SP collectives; DP all-reduce is once per step and can
-#: span the longer mesh dimension).
-AXES = (AXIS_DATA, AXIS_SEQ, AXIS_MODEL)
+#: span the longer mesh dimension). pipe sits between: stage boundaries are
+#: a single ppermute hop per microbatch, lower-traffic than TP but touched
+#: every scan iteration.
+AXES = (AXIS_DATA, AXIS_PIPE, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,26 +52,29 @@ class MeshConfig:
     data: int = -1
     seq: int = 1
     model: int = 1
+    pipe: int = 1
+    expert: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int]:
-        seq, model = self.seq, self.model
-        if seq <= 0 or model <= 0:
-            raise ValueError(f"seq/model axis sizes must be positive, got {self}")
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
+        rest = (self.pipe, self.expert, self.seq, self.model)
+        if any(s <= 0 for s in rest):
+            raise ValueError(
+                f"pipe/expert/seq/model axis sizes must be positive, got {self}")
+        rest_prod = math.prod(rest)
         data = self.data
         if data == 0 or data < -1:
             raise ValueError(
                 f"data axis size must be positive or -1 (infer), got {self}")
         if data == -1:
-            if n_devices % (seq * model):
+            if n_devices % rest_prod:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by seq*model={seq * model}"
-                )
-            data = n_devices // (seq * model)
-        if data * seq * model != n_devices:
+                    f"{n_devices} devices not divisible by "
+                    f"pipe*expert*seq*model={rest_prod}")
+            data = n_devices // rest_prod
+        if data * rest_prod != n_devices:
             raise ValueError(
-                f"mesh {data}x{seq}x{model} != {n_devices} devices"
-            )
-        return (data, seq, model)
+                f"mesh data={data} x {rest} != {n_devices} devices")
+        return (data,) + rest
 
 
 def make_mesh(
@@ -89,9 +100,9 @@ def make_mesh(
 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
-    """A 1x1x1 mesh for single-chip runs (the local dev/bench path)."""
+    """An all-ones (5-axis) mesh for single-chip runs (local dev/bench)."""
     device = device or jax.devices()[0]
-    return jax.make_mesh((1, 1, 1), AXES, devices=[device],
+    return jax.make_mesh((1,) * len(AXES), AXES, devices=[device],
                          axis_types=(jax.sharding.AxisType.Auto,) * len(AXES))
 
 

@@ -8,6 +8,10 @@
   (e.g. :data:`dtf_tpu.models.bert.tp_rules`).
 - **SP/CP** — ring attention over ``seq``
   (:mod:`dtf_tpu.ops.attention`).
+- **PP** — GPipe microbatch pipeline over ``pipe``: stage-stacked params,
+  schedule as one scan+ppermute shard_map (:mod:`dtf_tpu.parallel.pipeline`).
+- **EP (MoE)** — Switch-style expert-parallel FFN over ``expert``, token
+  dispatch via all-to-all einsums (:mod:`dtf_tpu.parallel.moe`).
 - **Embedding sharding** — PS-round-robin successor: row-sharded tables
   (:mod:`dtf_tpu.parallel.embedding`).
 - **DP (async)** — not reproduced: hogwild PS updates are an anti-pattern on

@@ -1,0 +1,134 @@
+"""Mixture-of-Experts with expert parallelism over the ``expert`` mesh axis.
+
+Not in the reference (SURVEY.md §2c marks EP out of its scope) — built
+because a complete TPU framework needs the sparse-FFN scaling axis. The
+design is the classic TPU MoE (Mesh-TF / GShard / Switch lineage), chosen
+because it is *all dense einsums* — exactly what GSPMD partitions well:
+
+- a router scores tokens per expert (f32 softmax);
+- top-1 (Switch) dispatch with a fixed capacity C per expert: token→slot
+  assignment becomes a one-hot dispatch tensor [G, E, C] (G = tokens);
+- ``expert_in = einsum('gec,gd->ecd', dispatch, x)`` — with the E dim
+  sharded ``P('expert')``, XLA lowers this to the token all-to-all over ICI;
+- each expert runs its FFN on its [C, d] slab (weights stacked [E, ...] and
+  expert-sharded — the MoE analogue of PS-sharded variables);
+- ``out = einsum('ecd,gec->gd', expert_out, combine)`` routes results back
+  (second all-to-all) scaled by the router gate.
+
+Static shapes throughout (capacity drop/pad instead of ragged dispatch):
+XLA-friendly, MXU-friendly, and the standard TPU trade — tokens past an
+expert's capacity are dropped (their residual path carries them).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MoeConfig:
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    #: load-balancing auxiliary loss weight (Switch eq. 4).
+    aux_loss_weight: float = 1e-2
+
+
+def top1_dispatch(router_logits: jax.Array, num_experts: int,
+                  capacity: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Switch-style top-1 routing → (dispatch [G,E,C], combine [G,E,C], aux).
+
+    ``router_logits`` [G, E] (f32). Tokens beyond an expert's capacity are
+    dropped (dispatch row all-zero). ``aux`` is the load-balance loss term:
+    E * Σ_e (fraction of tokens to e) * (mean router prob of e).
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate = probs.max(axis=-1)                                   # [G]
+    choice = probs.argmax(axis=-1)                              # [G]
+    onehot = jax.nn.one_hot(choice, num_experts,
+                            dtype=jnp.float32)                  # [G,E]
+    # position of each token within its chosen expert's queue
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0             # [G,E]
+    in_cap = (pos < capacity) & (onehot > 0)
+    pos = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    cap_onehot = jax.nn.one_hot(pos, capacity,
+                                dtype=jnp.float32)              # [G,E,C]
+    dispatch = cap_onehot * in_cap[..., None]
+    combine = dispatch * gate[:, None, None]
+    # load-balance aux (Switch Transformer eq. 4)
+    frac_tokens = onehot.mean(axis=0)                           # [E]
+    frac_probs = probs.mean(axis=0)                             # [E]
+    aux = num_experts * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
+class SwitchFFN(nn.Module):
+    """Expert-parallel FFN block (drop-in for a dense MLP in a transformer).
+
+    Input [B, T, d] → output [B, T, d]. Expert weights are stacked [E, ...]
+    and intended for ``P('expert', ...)`` sharding (see :func:`ep_rules`);
+    the dispatch/combine einsums then carry the all-to-alls. The router's
+    aux loss is stored in the ``losses`` collection (sow) — pull it with
+    ``mutable=['losses']`` and add ``aux_loss_weight`` x its mean to the loss.
+    """
+
+    d_model: int
+    d_ff: int
+    cfg: MoeConfig = MoeConfig()
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        g = b * t
+        e = self.cfg.num_experts
+        capacity = max(1, int(self.cfg.capacity_factor * g / e))
+        tokens = x.reshape(g, d)
+
+        router = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
+                          name="router")
+        dispatch, combine, aux = top1_dispatch(router(tokens), e, capacity)
+        self.sow("losses", "moe_aux", aux)
+
+        w_in = self.param("w_in", nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal"), (e, d, self.d_ff), jnp.float32)
+        w_out = self.param("w_out", nn.initializers.variance_scaling(
+            1.0, "fan_in", "truncated_normal"), (e, self.d_ff, d), jnp.float32)
+
+        # all-to-all #1: tokens → their expert's slab
+        slabs = jnp.einsum("gec,gd->ecd", dispatch.astype(self.dtype),
+                           tokens.astype(self.dtype))
+        h = jnp.einsum("ecd,edf->ecf", slabs, w_in.astype(self.dtype))
+        h = nn.gelu(h, approximate=True)
+        h = jnp.einsum("ecf,efd->ecd", h, w_out.astype(self.dtype))
+        # all-to-all #2: expert outputs → token order, gated
+        out = jnp.einsum("ecd,gec->gd", h.astype(jnp.float32),
+                         combine).astype(x.dtype)
+        return out.reshape(b, t, d)
+
+
+def ep_rules(axis: str = "expert"):
+    """Param-placement rules: expert-stacked weights sharded over ``axis``."""
+    return [(r"w_(in|out)$", P(axis, None, None))]
+
+
+def moe_aux_loss(mutables: dict, cfg: MoeConfig) -> jax.Array:
+    """Mean of all sown aux terms × weight (0 if the model has no MoE)."""
+    losses = mutables.get("losses", {})
+    leaves = jax.tree.leaves(losses)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return cfg.aux_loss_weight * sum(jnp.mean(l) for l in leaves) / len(leaves)
+
+
+def moe_activation_sharding(mesh: Mesh) -> Optional[jax.sharding.NamedSharding]:
+    """Sharding hint for the [E, C, d] slabs (constraint point if XLA's
+    propagation needs a nudge): experts over ``expert``."""
+    if mesh.shape.get("expert", 1) == 1:
+        return None
+    return jax.sharding.NamedSharding(mesh, P("expert", None, None))

@@ -1,0 +1,152 @@
+"""Pipeline parallelism — GPipe microbatch schedule as a single SPMD program.
+
+The reference has no pipeline parallelism (SURVEY.md §2c marks PP "out of
+reference scope"), but a complete TPU framework needs it for models whose
+layers don't fit one chip even under TP. This is the TPU-idiomatic design:
+instead of per-stage processes passing activations over a transport (the
+PS/worker shape), the per-stage parameters are *stacked* along a leading
+``stage`` dimension sharded over the ``pipe`` mesh axis, and the whole
+schedule — bubble included — is one ``lax.scan`` inside ``shard_map``:
+
+- every scan step, each stage applies ``stage_fn`` to its current activation
+  and ships the result one hop down the ring (``ppermute`` — a single ICI
+  neighbor transfer, exactly the point-to-point the hardware is best at);
+- stage 0 feeds microbatch ``t`` in at step ``t``; the last stage writes its
+  result for microbatch ``t - (S-1)`` into an output buffer;
+- the backward schedule needs no code: autodiff of scan+ppermute *is* the
+  reverse pipeline (activations are rematerialized per ``jax.checkpoint``
+  policy if the caller wraps ``stage_fn``).
+
+Composes with the other axes: batch dims inside a microbatch stay sharded
+over ``data`` (and ``seq``/``model`` inside ``stage_fn``), so dp x pp x tp is
+one program. Bubble fraction is the usual (S-1)/(M+S-1); choose
+``n_microbatches >= 4*n_stages`` to amortize.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.core.mesh import AXIS_PIPE
+
+PyTree = Any
+
+
+def stack_stage_params(params_per_stage: list[PyTree]) -> PyTree:
+    """Stack S per-stage param pytrees along a new leading stage dim."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_per_stage)
+
+
+def init_stacked(init_fn: Callable[[jax.Array], PyTree], n_stages: int,
+                 rng: jax.Array) -> PyTree:
+    """Initialize S independent stage params, stacked: vmap(init) over rngs.
+
+    The stacked tree is what gets sharded ``P('pipe', ...)`` — the successor
+    of the reference's per-PS variable placement, with stages instead of
+    parameter servers as the unit of distribution.
+    """
+    return jax.vmap(init_fn)(jax.random.split(rng, n_stages))
+
+
+def pipeline_spmd(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    n_microbatches: int,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_PIPE,
+    batch_spec: P = P("data"),
+    param_spec_fn: Callable[[Any], P] | None = None,
+):
+    """Build ``f(stacked_params, x) -> y`` running stages over ``axis_name``.
+
+    ``stage_fn(stage_params, x) -> y`` maps one stage over one microbatch and
+    must preserve the activation shape/dtype (the homogeneous-stack case —
+    transformer blocks; put embedding/head outside the pipeline).
+
+    ``x``: [B, ...] with B divisible by ``n_microbatches`` x data-shards.
+    ``stacked_params``: leading dim = pipe-axis size (see
+    :func:`init_stacked`), sharded ``P('pipe', ...)``.
+
+    Returns a function usable under ``jit``; gradients flow through to the
+    stacked params and the input.
+    """
+    n_stages = mesh.shape.get(axis_name, 1)
+
+    def sharded(params, x):
+        if x.shape[0] % n_microbatches:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by n_microbatches="
+                f"{n_microbatches}")
+        n_stacked = jax.tree.leaves(params)[0].shape[0]
+        if n_stacked != n_stages:
+            raise ValueError(
+                f"stage stack has {n_stacked} stages but the '{axis_name}' "
+                f"mesh axis has {n_stages} shards; they must match (each "
+                "device runs exactly one stage)")
+        if n_stages == 1:
+            # degenerate pipe axis: plain application, no schedule.
+            squeezed = jax.tree.map(lambda p: p[0], params)
+            return stage_fn(squeezed, x)
+
+        micro = x.reshape((n_microbatches, x.shape[0] // n_microbatches)
+                          + x.shape[1:])
+
+        def body(params, xs):
+            # per-shard: params [1, ...] slice of the stage stack; xs
+            # [M, mb/data, ...] microbatches (replicated over pipe).
+            # pvary: xs arrives replicated over pipe but mixes with
+            # pipe-varying values (stage outputs) below — shard_map's
+            # varying-manual-axes type system requires the promotion to be
+            # explicit.
+            xs = jax.lax.pcast(xs, (axis_name,), to="varying")
+            p = jax.tree.map(lambda t: t[0], params)
+            idx = jax.lax.axis_index(axis_name)
+            shift = [(i, i + 1) for i in range(n_stages - 1)]
+
+            def step(carry, t):
+                act, out = carry
+                x_t = jax.lax.dynamic_index_in_dim(
+                    xs, jnp.clip(t, 0, n_microbatches - 1), 0, keepdims=False)
+                inp = jnp.where(idx == 0, x_t, act)
+                y = stage_fn(p, inp)
+                # ship to the next stage; stage S-1's y falls off the end
+                # (shift is not a ring — no wraparound into stage 0).
+                act = jax.lax.ppermute(y, axis_name, shift)
+                ot = t - (n_stages - 1)
+                ot_c = jnp.clip(ot, 0, n_microbatches - 1)
+                write = (idx == n_stages - 1) & (ot >= 0)
+                cur = jax.lax.dynamic_index_in_dim(out, ot_c, 0,
+                                                   keepdims=False)
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, jnp.where(write, y, cur), ot_c, 0)
+                return (act, out), None
+
+            act0 = jnp.zeros_like(xs[0])
+            out0 = jnp.zeros_like(xs)
+            (_, out), _ = jax.lax.scan(
+                step, (act0, out0), jnp.arange(n_microbatches + n_stages - 1))
+            # outputs live on the last stage only (zeros elsewhere) —
+            # replicate over the pipe axis with one psum.
+            return jax.lax.psum(out, axis_name)
+
+        p_spec = (jax.tree.map(param_spec_fn, params)
+                  if param_spec_fn is not None
+                  else jax.tree.map(lambda _: P(axis_name), params))
+        micro_spec = P(None, *batch_spec)
+        y = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(p_spec, micro_spec), out_specs=micro_spec,
+        )(params, micro)
+        return y.reshape(x.shape[0:1] + y.shape[2:])
+
+    return sharded
+
+
+def stage_param_specs(params: PyTree, axis_name: str = AXIS_PIPE) -> PyTree:
+    """P('pipe') spec tree for a stacked-stage param tree (for train-state
+    sharding rules / create_train_state param_rules bypass)."""
+    return jax.tree.map(lambda _: P(axis_name), params)

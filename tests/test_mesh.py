@@ -7,12 +7,14 @@ from dtf_tpu.core.mesh import AXES, MeshConfig, make_mesh, mesh_summary, single_
 def test_default_mesh_all_data():
     mesh = make_mesh()
     assert mesh.axis_names == AXES
-    assert mesh.devices.shape == (8, 1, 1)
+    assert mesh.devices.shape == (8, 1, 1, 1, 1)
 
 
 def test_resolve_infers_data():
-    assert MeshConfig(seq=2, model=2).resolve(8) == (2, 2, 2)
-    assert MeshConfig(data=4, model=2).resolve(8) == (4, 1, 2)
+    assert MeshConfig(seq=2, model=2).resolve(8) == (2, 1, 1, 2, 2)
+    assert MeshConfig(data=4, model=2).resolve(8) == (4, 1, 1, 1, 2)
+    assert MeshConfig(pipe=4).resolve(8) == (2, 4, 1, 1, 1)
+    assert MeshConfig(expert=8).resolve(8) == (1, 1, 8, 1, 1)
 
 
 def test_resolve_rejects_bad_shapes():
@@ -22,14 +24,16 @@ def test_resolve_rejects_bad_shapes():
         MeshConfig(seq=3).resolve(8)
     with pytest.raises(ValueError):
         MeshConfig(model=0).resolve(8)
+    with pytest.raises(ValueError):
+        MeshConfig(pipe=16).resolve(8)
 
 
 def test_mesh_3d(mesh_2x2x2):
-    assert mesh_2x2x2.devices.shape == (2, 2, 2)
+    assert mesh_2x2x2.devices.shape == (2, 1, 1, 2, 2)
     assert "data=2" in mesh_summary(mesh_2x2x2)
 
 
 def test_single_device_mesh():
     mesh = single_device_mesh()
-    assert mesh.devices.shape == (1, 1, 1)
+    assert mesh.devices.shape == (1,) * len(AXES)
     assert mesh.devices.flat[0] == jax.devices()[0]
