@@ -38,9 +38,11 @@ def _rng_for(seed: int, step: int, host: int) -> np.random.Generator:
 class SyntheticData:
     """Deterministic, host-sharded synthetic batches.
 
-    ``kind`` ∈ {mnist, cifar, imagenet, bert, widedeep} — one per BASELINE
-    config. Labels are derived from the inputs (not pure noise) so that
-    models can actually fit them and "loss decreases" is a meaningful test.
+    ``kind`` ∈ {mnist, cifar, imagenet, bert, gpt, widedeep} — one per
+    BASELINE config plus ``gpt`` (causal-LM next-token batches for the
+    long-context flagship). Labels are derived from the inputs (not pure
+    noise) so that models can actually fit them and "loss decreases" is a
+    meaningful test.
     """
 
     def __init__(self, kind: str, batch_size: int, *, seed: int = 0,
@@ -59,7 +61,8 @@ class SyntheticData:
         self.vocab = vocab_size
         self.num_sparse = num_sparse
         self.hash_buckets = hash_buckets
-        if kind not in ("mnist", "cifar", "imagenet", "bert", "widedeep"):
+        if kind not in ("mnist", "cifar", "imagenet", "bert", "gpt",
+                        "widedeep"):
             raise ValueError(f"unknown synthetic dataset kind: {kind!r}")
 
     def batch(self, step: int) -> Batch:
@@ -88,6 +91,17 @@ class SyntheticData:
             return {"input_ids": masked, "segment_ids": segment,
                     "attention_mask": np.ones((n, self.seq_len), np.int32),
                     "mlm_labels": labels}
+        if self.kind == "gpt":
+            # learnable structure: token t+1 = (a*token_t + b) mod V on half
+            # the stream, noise on the rest — next-token CE can fall.
+            ids = r.integers(0, self.vocab, (n, self.seq_len + 1), np.int32)
+            a, b = 3, 7
+            det = (a * ids[:, :-1] + b) % self.vocab
+            use_det = r.random((n, self.seq_len)) < 0.5
+            ids[:, 1:] = np.where(use_det, det, ids[:, 1:])
+            labels = ids[:, 1:].astype(np.int32)
+            return {"input_ids": ids[:, :-1].astype(np.int32),
+                    "labels": labels}
         # widedeep: criteo-like 13 dense + num_sparse categorical features.
         dense = r.standard_normal((n, 13)).astype(np.float32)
         sparse = r.integers(0, self.hash_buckets,

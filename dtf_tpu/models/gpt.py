@@ -1,0 +1,232 @@
+"""GPT-style causal decoder LM — the long-context flagship.
+
+Beyond the reference's capability list (SURVEY.md §5.7: nothing in
+`zjj2wry/distributed-tensorflow` scales sequence length), but first-class
+here: this model is the consumer that ties the framework's long-context and
+parallelism machinery together —
+
+- **flash attention** (:mod:`dtf_tpu.ops.flash_attention`): fused Pallas
+  kernel for the single/tensor-parallel path, wrapped in ``shard_map`` over
+  (data, model) so batch/head shards each run a local kernel;
+- **ring attention** (:mod:`dtf_tpu.ops.attention`): context parallelism
+  over the ``seq`` axis for sequences that don't fit one chip;
+- **Megatron TP** over ``model`` (:data:`tp_rules`), same scheme as BERT;
+- optional **Switch-MoE** FFN layers (:mod:`dtf_tpu.parallel.moe`) for
+  expert parallelism over ``expert``;
+- **remat** (``jax.checkpoint``) per block — the HBM-for-FLOPs trade that
+  long sequences need.
+
+Pre-LN blocks, RoPE positions (global positions, so they are correct under
+sequence sharding), untied LM head, bf16 compute / f32 params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.core.train import LossAux
+from dtf_tpu.ops import attention as att
+from dtf_tpu.ops import flash_attention as fa
+from dtf_tpu.ops.losses import softmax_cross_entropy
+from dtf_tpu.parallel import moe as moe_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    d_model: int = 768
+    layers: int = 12
+    heads: int = 12
+    d_ff: int = 3072
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.bfloat16
+    rope_theta: float = 10000.0
+    #: attention backend: auto (ring if seq-sharded, flash on tpu, else
+    #: dense), or force one of dense|flash|ring.
+    attn_impl: str = "auto"
+    #: every k-th block uses a Switch-MoE FFN (0 = all dense).
+    moe_every: int = 0
+    moe: moe_lib.MoeConfig = moe_lib.MoeConfig()
+    #: jax.checkpoint each block (long-context memory trade).
+    remat: bool = False
+
+    @staticmethod
+    def gpt2_small() -> "GPTConfig":
+        return GPTConfig()
+
+    @staticmethod
+    def tiny(**kw) -> "GPTConfig":
+        return GPTConfig(vocab_size=128, d_model=32, layers=2, heads=4,
+                         d_ff=64, **kw)
+
+
+#: Megatron TP placement over the `model` mesh axis.
+tp_rules = [
+    (r"token_embed/embedding", P("model", None)),
+    (r"(query|key|value)/kernel", P(None, "model")),
+    (r"attn_out/kernel", P("model", None)),
+    (r"mlp_in/kernel", P(None, "model")),
+    (r"mlp_out/kernel", P("model", None)),
+    (r"(query|key|value|mlp_in)/bias", P("model")),
+    (r"lm_head/kernel", P(None, "model")),
+] + moe_lib.ep_rules()
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x [B,H,T,D] (D even), positions [T] global indices —
+    correct under seq sharding because positions are global, not local."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    out = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def _flash_sharded(q, k, v, mesh: Optional[Mesh], interpret: bool):
+    """Per-shard flash kernel over (data, model): batch/head dims are
+    partitioned, seq stays whole. Pallas calls can't be GSPMD-partitioned
+    from outside, so the shard_map boundary is where the parallelism lives."""
+    if mesh is None:
+        return fa.flash_attention(q, k, v, causal=True, interpret=interpret)
+    fn = partial(fa.flash_attention, causal=True, interpret=interpret)
+    spec = P("data", "model", None, None)
+    # check_vma=False: pallas_call out_shapes carry no varying-manual-axes
+    # info, so shard_map's vma checker can't type them.
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+class CausalSelfAttention(nn.Module):
+    cfg: GPTConfig
+    mesh: Optional[Mesh]
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.cfg
+        d_head = cfg.d_model // cfg.heads
+        t = x.shape[1]
+        dense = lambda name: nn.Dense(  # noqa: E731
+            cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+
+        def split(v):
+            return v.reshape(v.shape[0], t, cfg.heads, d_head).transpose(
+                0, 2, 1, 3)
+
+        q, k, v = (split(dense(n)(x)) for n in ("query", "key", "value"))
+        positions = jnp.arange(t)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        impl = cfg.attn_impl
+        seq_sharded = (self.mesh is not None
+                       and self.mesh.shape.get("seq", 1) > 1)
+        if impl == "auto":
+            if seq_sharded:
+                impl = "ring"
+            elif jax.default_backend() == "tpu":
+                impl = "flash"
+            else:
+                impl = "dense"
+        if impl == "ring":
+            out = att.ring_attention_sharded(q, k, v, self.mesh, causal=True)
+        elif impl == "flash":
+            out = _flash_sharded(q, k, v, self.mesh,
+                                 interpret=jax.default_backend() != "tpu")
+        else:
+            out = att.dense_attention(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], t, cfg.d_model)
+        out = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="attn_out")(out)
+        return nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
+
+
+class Block(nn.Module):
+    cfg: GPTConfig
+    mesh: Optional[Mesh]
+    use_moe: bool
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        cfg = self.cfg
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
+        x = x + CausalSelfAttention(cfg, self.mesh, name="attention")(
+            h, deterministic)
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
+        if self.use_moe:
+            y = moe_lib.SwitchFFN(cfg.d_model, cfg.d_ff, cfg.moe,
+                                  dtype=cfg.dtype, name="moe")(h)
+        else:
+            y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="mlp_in")(h)
+            y = nn.gelu(y, approximate=True)
+            y = nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=jnp.float32, name="mlp_out")(y)
+        y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        return x + y
+
+
+class GPT(nn.Module):
+    """Decoder-only LM. Input ids [B,T] → logits [B,T,V]."""
+
+    cfg: GPTConfig
+    mesh: Optional[Mesh] = None
+
+    @nn.compact
+    def __call__(self, input_ids, *, deterministic: bool = True):
+        cfg = self.cfg
+        x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="token_embed")(input_ids)
+        x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, static_argnums=(2,))
+        for i in range(cfg.layers):
+            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            x = block(cfg, self.mesh, use_moe, name=f"layer_{i}")(
+                x, deterministic)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="lm_head")(x)
+        return logits
+
+
+def make_init(cfg: GPTConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
+    model = GPT(cfg, mesh)
+    b = mesh.shape.get("data", 1) if mesh is not None else 1
+
+    def init_fn(rng):
+        ids = jnp.zeros((b, seq_len), jnp.int32)
+        return model.init(rng, ids, deterministic=True)
+
+    return model, init_fn
+
+
+def make_loss(model: GPT):
+    """Next-token CE: batch = {"input_ids" [B,T], "labels" [B,T]} where
+    labels are input_ids shifted left by the data layer (-100 = ignore)."""
+
+    def loss_fn(params, extra, batch, rng):
+        cfg = model.cfg
+        out = model.apply(
+            {"params": params}, batch["input_ids"],
+            deterministic=cfg.dropout == 0.0,
+            rngs={"dropout": rng} if cfg.dropout else {},
+            mutable=["losses"] if cfg.moe_every else False)
+        logits, mut = out if cfg.moe_every else (out, {})
+        loss, n = softmax_cross_entropy(logits, batch["labels"],
+                                        ignore_index=-100)
+        loss = loss + moe_lib.moe_aux_loss(mut, cfg.moe)
+        return loss, LossAux(extra=extra, metrics={"lm_tokens": n}, weight=n)
+
+    return loss_fn

@@ -23,12 +23,11 @@ expert's capacity are dropped (their residual path carries them).
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 
 @dataclasses.dataclass(frozen=True)
@@ -124,11 +123,3 @@ def moe_aux_loss(mutables: dict, cfg: MoeConfig) -> jax.Array:
     if not leaves:
         return jnp.zeros((), jnp.float32)
     return cfg.aux_loss_weight * sum(jnp.mean(l) for l in leaves) / len(leaves)
-
-
-def moe_activation_sharding(mesh: Mesh) -> Optional[jax.sharding.NamedSharding]:
-    """Sharding hint for the [E, C, d] slabs (constraint point if XLA's
-    propagation needs a nudge): experts over ``expert``."""
-    if mesh.shape.get("expert", 1) == 1:
-        return None
-    return jax.sharding.NamedSharding(mesh, P("expert", None, None))

@@ -135,7 +135,7 @@ def pipeline_spmd(
 
         p_spec = (jax.tree.map(param_spec_fn, params)
                   if param_spec_fn is not None
-                  else jax.tree.map(lambda _: P(axis_name), params))
+                  else stage_param_specs(params, axis_name))
         micro_spec = P(None, *batch_spec)
         y = jax.shard_map(
             body, mesh=mesh,
