@@ -93,12 +93,15 @@ class SyntheticData:
                     "mlm_labels": labels}
         if self.kind == "gpt":
             # learnable structure: token t+1 = (a*token_t + b) mod V on half
-            # the stream, noise on the rest — next-token CE can fall.
+            # the positions, noise on the rest — next-token CE can fall.
+            # Built sequentially so the relation holds on the post-replacement
+            # (visible) stream even across chained deterministic positions.
             ids = r.integers(0, self.vocab, (n, self.seq_len + 1), np.int32)
-            a, b = 3, 7
-            det = (a * ids[:, :-1] + b) % self.vocab
             use_det = r.random((n, self.seq_len)) < 0.5
-            ids[:, 1:] = np.where(use_det, det, ids[:, 1:])
+            a, b = 3, 7
+            for t in range(self.seq_len):
+                det = (a * ids[:, t] + b) % self.vocab
+                ids[:, t + 1] = np.where(use_det[:, t], det, ids[:, t + 1])
             labels = ids[:, 1:].astype(np.int32)
             return {"input_ids": ids[:, :-1].astype(np.int32),
                     "labels": labels}
