@@ -1,0 +1,93 @@
+"""Pallas TPU embedding-row gather — the recsys hot op (BASELINE config 5).
+
+The reference served embedding lookups from parameter servers: each lookup
+was a remote sparse gather over gRPC against PS-hosted tables
+(SURVEY.md §2c "Embedding sharding"). The GSPMD successor keeps tables
+row-sharded on device (:mod:`dtf_tpu.parallel.embedding`); this module adds
+the first-party kernel for the lookup itself — SURVEY.md §7 hard-part #4,
+"sparse lookups under GSPMD are the one place a Pallas kernel may actually
+be required".
+
+Design: one grid step per lookup row. The ids vector is a *scalar-prefetch*
+operand (SMEM, available before the body runs), so each step's BlockSpec
+``index_map`` points the input DMA straight at table row ``ids[i]`` — the
+gather IS the pipeline's address stream, there is no one-hot matmul and no
+[B, R] intermediate anywhere. Rows stream HBM→VMEM→HBM with double
+buffering handled by the Pallas pipeline.
+
+Backward is a scatter-add of the output cotangent into a zero table —
+expressed as ``zeros.at[ids].add(ct)`` (XLA's sort-based scatter), attached
+via ``custom_vjp`` since the kernel itself is not differentiable.
+
+The sharded/masked wrapper lives in :mod:`dtf_tpu.parallel.embedding`
+(``masked_lookup_sharded(use_kernel=True)``) — one implementation of the
+range-masking + psum math serves both the ``jnp.take`` and kernel paths.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gather_kernel(ids_ref, table_ref, out_ref):
+    del ids_ref  # consumed by the index_map; body just moves the row
+    out_ref[...] = table_ref[...]
+
+
+def _pallas_gather(table: jax.Array, ids: jax.Array,
+                   interpret: bool) -> jax.Array:
+    b = ids.shape[0]
+    _, d = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, ids_ref: (ids_ref[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, ids_ref: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, d), table.dtype),
+        interpret=interpret,
+    )(ids, table)
+
+
+# module-level custom_vjp (not per-call closures) so repeated calls with the
+# same shapes hit JAX's compilation cache; interpret/n_rows are static.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _gather(table, ids, interpret, n_rows):
+    del n_rows
+    return _pallas_gather(table, ids, interpret)
+
+
+def _gather_fwd(table, ids, interpret, n_rows):
+    del n_rows
+    return _pallas_gather(table, ids, interpret), ids
+
+
+def _gather_bwd(interpret, n_rows, ids, ct):
+    del interpret
+    dt = jnp.zeros((n_rows, ct.shape[-1]), jnp.float32).at[ids].add(
+        ct.astype(jnp.float32))
+    return dt.astype(ct.dtype), None
+
+
+_gather.defvjp(_gather_fwd, _gather_bwd)
+
+
+def gather_rows(table: jax.Array, ids: jax.Array, *,
+                interpret: bool = False) -> jax.Array:
+    """``table[ids]`` as a fused Pallas gather. table [R,D], ids [...] int32
+    in ``[0, R)``; returns [..., D]. Differentiable w.r.t. ``table``."""
+    if table.ndim != 2:
+        raise ValueError(f"expected table [R,D], got {table.shape}")
+    flat = ids.reshape(-1)
+    out = _gather(table, flat, bool(interpret), table.shape[0])
+    return out.reshape(ids.shape + (table.shape[1],))

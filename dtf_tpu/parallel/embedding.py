@@ -26,35 +26,55 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def masked_lookup(table_shard: jax.Array, ids: jax.Array,
-                  axis_name: str) -> jax.Array:
+                  axis_name: str, gather_fn=None) -> jax.Array:
     """Per-shard body: lookup ids that land in this shard's rows, psum.
 
     ``table_shard`` [rows/n, dim]; ``ids`` [...] global row indices
     (replicated across the axis). Returns [..., dim] fully-reduced.
+    ``gather_fn(table_shard, safe_ids) -> rows`` swaps the row gather
+    (default ``jnp.take``; the Pallas kernel path passes
+    :func:`dtf_tpu.ops.embed_gather.gather_rows`).
     """
     n_local = table_shard.shape[0]
     start = jax.lax.axis_index(axis_name) * n_local
     local = ids - start
     in_range = (local >= 0) & (local < n_local)
     safe = jnp.clip(local, 0, n_local - 1)
-    rows = jnp.take(table_shard, safe, axis=0)
+    if gather_fn is None:
+        rows = jnp.take(table_shard, safe, axis=0)
+    else:
+        rows = gather_fn(table_shard, safe)
     rows = jnp.where(in_range[..., None], rows, 0)
     return jax.lax.psum(rows, axis_name)
 
 
 def masked_lookup_sharded(table: jax.Array, ids: jax.Array, mesh: Mesh,
                           *, axis: str = "model",
-                          ids_spec: P = P("data")) -> jax.Array:
+                          ids_spec: P = P("data"),
+                          use_kernel: bool = False) -> jax.Array:
     """Global-array wrapper over :func:`masked_lookup`.
 
     ``table`` row-sharded over ``axis``; ``ids`` sharded over ``data``.
+    ``use_kernel=True`` swaps the per-shard lookup for the fused Pallas
+    gather (:mod:`dtf_tpu.ops.embed_gather` — rows stream HBM→VMEM with the
+    ids as the DMA address stream; same masked+psum semantics).
     """
-    fn = functools.partial(masked_lookup, axis_name=axis)
+    gather_fn = None
+    extra = {}
+    if use_kernel:
+        from dtf_tpu.ops.embed_gather import gather_rows
+
+        gather_fn = functools.partial(
+            gather_rows, interpret=jax.default_backend() != "tpu")
+        # pallas out_shapes carry no varying-manual-axes info
+        extra = {"check_vma": False}
+    fn = functools.partial(masked_lookup, axis_name=axis,
+                           gather_fn=gather_fn)
     out_spec = P(*ids_spec, *([None] * 1))
     return jax.shard_map(
         fn, mesh=mesh,
         in_specs=(P(axis, None), ids_spec),
-        out_specs=out_spec)(table, ids)
+        out_specs=out_spec, **extra)(table, ids)
 
 
 class RowShardedEmbed(nn.Module):

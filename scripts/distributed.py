@@ -93,9 +93,26 @@ def main(argv):
                StopAtStepHook(FLAGS.train_steps)],
         checkpointer=ckpt)
     state = trainer.fit(state, iter(data))
+
+    # final eval (the reference's script printed test accuracy at the end):
+    # real data → the t10k test split; synthetic → a held-out step index.
+    if isinstance(data, SyntheticData):
+        eval_batch = data.batch(10_000_019)
+    else:
+        eval_batch = next(iter(mnist_data.MnistData(
+            FLAGS.data_dir, FLAGS.batch_size, split="test", seed=FLAGS.seed,
+            host_index=info.process_id, host_count=info.num_processes)))
+    eval_step = tr.make_eval_step(mnist_model.make_eval(model), mesh,
+                                  shardings)
+    from dtf_tpu.core.comms import shard_batch
+
+    eval_metrics = eval_step(state, shard_batch(eval_batch, mesh))
+    writer.write_scalars(int(state.step),
+                         {k: float(v) for k, v in eval_metrics.items()})
     writer.close()
     ckpt.close()
-    print(f"done: step={int(state.step)}")
+    print(f"done: step={int(state.step)} "
+          f"eval_accuracy={float(eval_metrics['eval_accuracy']):.4f}")
 
 
 if __name__ == "__main__":
