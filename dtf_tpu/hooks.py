@@ -103,6 +103,43 @@ class CheckpointHook(Hook):
         self.ckpt.wait()
 
 
+class EvalHook(Hook):
+    """Periodic evaluation — the reference-era validation-while-training
+    pattern (an eval pass between ``mon_sess.run`` steps), as a hook.
+
+    ``eval_step(state, batch) -> metrics`` is a compiled step from
+    :func:`dtf_tpu.core.train.make_eval_step`; ``batches()`` returns an
+    iterable of host batches for one eval sweep (metrics are averaged);
+    ``place_batch`` maps them onto the mesh.
+    """
+
+    def __init__(self, eval_step, batches, writer: MetricWriter,
+                 every_n: int = 100, *, place_batch=None):
+        self.eval_step = eval_step
+        self.batches = batches
+        self.writer = writer
+        self.every_n = every_n
+        self.place_batch = place_batch or (lambda b: b)
+
+    def _run(self, step, state):
+        totals, n = {}, 0
+        for batch in self.batches():
+            metrics = self.eval_step(state, self.place_batch(batch))
+            for k, v in metrics.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            n += 1
+        if n:
+            self.writer.write_scalars(step,
+                                      {k: v / n for k, v in totals.items()})
+
+    def after_step(self, step, state, metrics):
+        if step % self.every_n == 0:
+            self._run(step, state)
+
+    def end(self, state):
+        self._run(int(state.step), state)
+
+
 class ProfilerHook(Hook):
     """``tf.profiler``/Timeline equivalent: capture an XPlane trace window."""
 

@@ -56,6 +56,9 @@ class GPTConfig:
     moe: moe_lib.MoeConfig = moe_lib.MoeConfig()
     #: jax.checkpoint each block (long-context memory trade).
     remat: bool = False
+    #: >0 enables single-token decode mode with a KV cache of this length
+    #: (the "cache" collection; see :func:`generate`).
+    decode_len: int = 0
 
     @staticmethod
     def gpt2_small() -> "GPTConfig":
@@ -124,6 +127,38 @@ class CausalSelfAttention(nn.Module):
                 0, 2, 1, 3)
 
         q, k, v = (split(dense(n)(x)) for n in ("query", "key", "value"))
+
+        if cfg.decode_len > 0:
+            # KV-cache decode: one token in, attend against all cached
+            # positions <= idx. Cache layout [B, H, L, D] matches training.
+            if t != 1:
+                raise ValueError(
+                    f"decode mode takes one token per call, got T={t}")
+            b = x.shape[0]
+            ck = self.variable("cache", "cached_key", jnp.zeros,
+                               (b, cfg.heads, cfg.decode_len, d_head),
+                               cfg.dtype)
+            cv = self.variable("cache", "cached_value", jnp.zeros,
+                               (b, cfg.heads, cfg.decode_len, d_head),
+                               cfg.dtype)
+            ci = self.variable("cache", "cache_index",
+                               lambda: jnp.zeros((), jnp.int32))
+            idx = ci.value
+            pos = idx[None]
+            q = rope(q, pos, cfg.rope_theta)
+            k = rope(k, pos, cfg.rope_theta)
+            ck.value = jax.lax.dynamic_update_slice_in_dim(
+                ck.value, k.astype(cfg.dtype), idx, axis=2)
+            cv.value = jax.lax.dynamic_update_slice_in_dim(
+                cv.value, v.astype(cfg.dtype), idx, axis=2)
+            ci.value = idx + 1
+            valid = jnp.arange(cfg.decode_len) <= idx           # [L]
+            bias = jnp.where(valid, 0.0, -jnp.inf)[None, None, None, :]
+            out = att.dense_attention(q, ck.value, cv.value, bias=bias)
+            out = out.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+            return nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                            param_dtype=jnp.float32, name="attn_out")(out)
+
         positions = jnp.arange(t)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
@@ -210,6 +245,56 @@ def make_init(cfg: GPTConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
         return model.init(rng, ids, deterministic=True)
 
     return model, init_fn
+
+
+def generate(model: GPT, params, prompt: jax.Array, n_new: int,
+             *, rng: Optional[jax.Array] = None,
+             temperature: float = 0.0) -> jax.Array:
+    """Autoregressive decode with the KV cache, as one ``lax.scan``.
+
+    ``model.cfg.decode_len`` must cover prompt+new tokens. ``prompt``
+    [B, T_p] int32; returns [B, T_p + n_new]. Greedy when temperature==0,
+    else temperature sampling. The whole loop is jittable: the cache is
+    scan-carried state, one token per step — the standard TPU decode shape.
+    """
+    cfg = model.cfg
+    b, t_p = prompt.shape
+    total = t_p + n_new
+    if cfg.decode_len < total:
+        raise ValueError(
+            f"decode_len={cfg.decode_len} < prompt+new={total}")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((b, 1), jnp.int32))
+    cache0 = variables["cache"]
+
+    def body(carry, t):
+        cache, tok, rng = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            deterministic=True, mutable=["cache"])
+        nxt_logits = logits[:, 0]
+        rng, sub = jax.random.split(rng)
+        if temperature > 0.0:
+            nxt = jax.random.categorical(sub, nxt_logits / temperature, -1)
+        else:
+            nxt = jnp.argmax(nxt_logits, -1)
+        nxt = nxt.astype(jnp.int32)
+        # teacher-force while still inside the prompt
+        in_prompt = t + 1 < t_p
+        tok_next = jnp.where(in_prompt,
+                             jax.lax.dynamic_index_in_dim(
+                                 prompt, jnp.minimum(t + 1, t_p - 1), 1,
+                                 keepdims=False),
+                             nxt)
+        return (mut["cache"], tok_next, rng), tok_next
+
+    (_, _, _), toks = jax.lax.scan(
+        body, (cache0, prompt[:, 0], rng), jnp.arange(total - 1))
+    out = jnp.concatenate([prompt[:, :1], toks.T.astype(jnp.int32)], axis=1)
+    return out
 
 
 def make_loss(model: GPT):

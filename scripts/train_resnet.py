@@ -25,6 +25,8 @@ dflags.define_train_flags(batch_size=256, learning_rate=0.1, train_steps=500)
 flags.DEFINE_string("config", "cifar", "cifar (ResNet-20) | imagenet "
                     "(ResNet-50)")
 flags.DEFINE_float("weight_decay", 1e-4, "L2 on conv/dense kernels")
+flags.DEFINE_integer("eval_every", 0, "run a small eval sweep every N steps "
+                     "(0 = final eval only)")
 FLAGS = flags.FLAGS
 
 
@@ -37,7 +39,9 @@ def main(argv):
     from dtf_tpu.cli.launch import setup
     from dtf_tpu.core import train as tr
     from dtf_tpu.data.synthetic import SyntheticData
-    from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
+    from dtf_tpu.core.comms import shard_batch
+    from dtf_tpu.hooks import (CheckpointHook, EvalHook, LoggingHook,
+                               StopAtStepHook)
     from dtf_tpu.loop import Trainer
     from dtf_tpu.metrics import MetricWriter
     from dtf_tpu.models import resnet
@@ -68,10 +72,20 @@ def main(argv):
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
+    eval_step = tr.make_eval_step(resnet.make_eval(model), mesh, shardings)
+    eval_data = SyntheticData(kind, FLAGS.batch_size, seed=FLAGS.seed + 1,
+                              host_index=info.process_id,
+                              host_count=info.num_processes)
+    eval_hook = EvalHook(
+        eval_step,
+        lambda: (eval_data.batch(10_000_000 + i) for i in range(4)),
+        writer, FLAGS.eval_every or FLAGS.train_steps,
+        place_batch=lambda b: shard_batch(b, mesh))
     trainer = Trainer(
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
+               eval_hook,
                StopAtStepHook(FLAGS.train_steps)],
         checkpointer=ckpt)
     state = trainer.fit(state, iter(data))

@@ -8,7 +8,8 @@ import pytest
 
 from dtf_tpu.checkpoint import Checkpointer
 from dtf_tpu.core import train as tr
-from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
+from dtf_tpu.hooks import (CheckpointHook, EvalHook, LoggingHook,
+                           StopAtStepHook)
 from dtf_tpu.loop import Trainer
 from dtf_tpu.metrics import MetricWriter
 
@@ -88,3 +89,31 @@ def test_restore_missing_raises(mesh8, tmp_path):
         ckpt.restore(state)
     same, restored = ckpt.restore_if_exists(state)
     assert restored is None and same is state
+
+
+def test_eval_hook_runs_and_averages(mesh8):
+    from dtf_tpu.core.comms import shard_batch
+    from tests.test_train import linear_eval
+
+    state, step = build(mesh8)
+    eval_step = tr.make_eval_step(linear_eval, mesh8, None)
+    written = []
+
+    class Capture:
+        def write_scalars(self, step, scalars):
+            written.append((step, scalars))
+
+        def flush(self):
+            pass
+
+    hook = EvalHook(eval_step, lambda: (make_batch(seed=100 + i)
+                                        for i in range(3)),
+                    Capture(), every_n=2,
+                    place_batch=lambda b: shard_batch(b, mesh8))
+    Trainer(step, mesh8, hooks=[hook, StopAtStepHook(4)]).fit(
+        state, batches(10))
+    # eval at steps 2 and 4, plus the end-of-training sweep at step 4
+    steps = [s for s, _ in written]
+    assert steps == [2, 4, 4]
+    for _, scalars in written:
+        assert "eval_loss" in scalars and np.isfinite(scalars["eval_loss"])

@@ -21,6 +21,11 @@ def linear_loss(params, extra, batch, rng):
     return loss, tr.LossAux(extra=extra, metrics={"mse": loss})
 
 
+def linear_eval(params, extra, batch):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return {"eval_loss": jnp.mean((pred - batch["y"]) ** 2)}
+
+
 def make_batch(n=64, seed=0):
     r = np.random.RandomState(seed)
     x = r.randn(n, 4).astype(np.float32)
