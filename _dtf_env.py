@@ -1,0 +1,42 @@
+"""Clean CPU-sim environment construction, shared by every bootstrap.
+
+tests/conftest.py, __graft_entry__.py, and scripts/bench_attention.py all
+need the same thing: a child/re-exec environment pinned to an N-virtual-
+device CPU backend with every axon/TPU backend-selection knob scrubbed (the
+sitecustomize grabs the real chip whenever PALLAS_AXON_POOL_IPS is set, and
+the axon backend can hang indefinitely). One scrub list lives here so a new
+backend env var can't silently miss one of the copies. Must stay importable
+without jax.
+"""
+
+from __future__ import annotations
+
+#: every env var that can route a JAX process to the real accelerator
+SCRUB_VARS = (
+    "PALLAS_AXON_POOL_IPS",
+    "PALLAS_AXON_REMOTE_COMPILE",
+    "AXON_LOOPBACK_RELAY",
+    "JAX_PLATFORM_NAME",
+)
+
+
+def device_flag(n_devices: int) -> str:
+    return f"--xla_force_host_platform_device_count={n_devices}"
+
+
+def is_cpu_sim(env, n_devices: int) -> bool:
+    """True when ``env`` already pins this process to an n-device CPU sim."""
+    return (env.get("JAX_PLATFORMS") == "cpu"
+            and not env.get("PALLAS_AXON_POOL_IPS")
+            and device_flag(n_devices) in env.get("XLA_FLAGS", ""))
+
+
+def cpu_sim_env(n_devices: int, base_env) -> dict:
+    """A copy of ``base_env`` scrubbed and pinned to the n-device CPU sim."""
+    env = dict(base_env)
+    for var in SCRUB_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " "
+                        + device_flag(n_devices)).strip()
+    return env

@@ -1,0 +1,51 @@
+"""Watchdogged-subprocess runner shared by bench.py and scripts/tpu_smoke.py.
+
+The experimental axon PJRT backend can hang during setup (VERDICT r1: a bare
+``jax.devices()`` blocked >9 minutes), so anything that must produce an
+artifact runs its measurement in a child process with a hard timeout and
+retries, and the parent NEVER imports jax. This module must therefore stay
+importable without jax/dtf_tpu.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+
+def run_watchdogged(argv: list[str], parse_line: Callable[[str], object], *,
+                    timeout_s: float, retries: int = 3, backoff_s: float = 15,
+                    env: Optional[dict] = None):
+    """Run ``argv`` under a timeout, retrying with linear backoff.
+
+    After each attempt the child's stdout is scanned bottom-up; the first
+    line for which ``parse_line`` returns non-None is the result. Returns
+    ``(result, errors)`` — result None if every attempt failed, errors a
+    list of one human-readable string per failed attempt.
+    """
+    errors: list[str] = []
+    for attempt in range(retries):
+        if attempt:
+            time.sleep(backoff_s * attempt)
+        try:
+            proc = subprocess.run(
+                argv, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, timeout=timeout_s, text=True)
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt + 1}: timeout after "
+                          f"{timeout_s}s (backend hang?)")
+            continue
+        for line in reversed(proc.stdout.strip().splitlines()):
+            result = parse_line(line)
+            if result is not None:
+                return result, errors
+        tail = (proc.stderr or "").strip().splitlines()[-5:]
+        errors.append(f"attempt {attempt + 1}: rc={proc.returncode}, "
+                      f"stderr tail: {' | '.join(tail) if tail else 'empty'}")
+    return None, errors
+
+
+def child_argv(script_path: str) -> list[str]:
+    return [sys.executable, script_path, "--child"]

@@ -2,26 +2,49 @@
 """Headline benchmark: ResNet-50/ImageNet training throughput on one chip.
 
 BASELINE.json's metric is "ImageNet ResNet-50 images/sec/chip" with a
-north-star of step-time parity vs 8×A100 MultiWorkerMirroredStrategy+NCCL.
-The reference publishes no measured numbers (BASELINE.json "published": {}),
-so vs_baseline is computed against the A100 per-chip anchor implied by the
-north star: 8×A100 MWMS ResNet-50 ≈ 2500 images/sec/GPU in mixed precision
-(MLPerf-era TF numbers), i.e. parity ⇔ vs_baseline ≈ 1.0 on a per-chip basis.
+north-star of ">=60% MFU, step-time parity vs 8xA100 MWMS+NCCL". The
+reference publishes no measured numbers (BASELINE.json "published": {}), so
+vs_baseline is computed against the A100 per-chip anchor implied by the
+north star: 8xA100 MWMS ResNet-50 ~ 2500 images/sec/GPU in mixed precision
+(MLPerf-era TF numbers), i.e. parity <=> vs_baseline ~ 1.0 per chip. MFU is
+computed from first principles (see _MFU notes below) so the >=60% north
+star is directly measurable.
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Resilience contract (VERDICT r1 #2): the experimental `axon` PJRT backend
+can hang during setup, so the measurement runs in a watchdogged subprocess
+with retries; this parent NEVER imports jax. Whatever happens, stdout's
+LAST line is exactly one JSON object:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": N}
+or, on unrecoverable failure,
+  {"metric": ..., "value": 0, "unit": ..., "vs_baseline": 0, "error": "..."}
 """
 
 import json
+import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
 A100_PER_CHIP_IMG_S = 2500.0
 
+# ResNet-50 v1.5 forward pass at 224x224 is ~4.09e9 MAC-derived FLOPs/image
+# (2 FLOPs per MAC, the convention MLPerf/"How to Scale Your Model" use).
+# Training = fwd + bwd ~ 3x forward.
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+# TPU v5e (v5 lite) peak bf16 matmul throughput per chip.
+V5E_PEAK_BF16_FLOPS = 197e12
 
-def main():
+METRIC = "resnet50_imagenet_train_images_per_sec_per_chip"
+
+CHILD_TIMEOUT_S = 900        # compile (~20-40s warm, worse cold) + 20 steps
+RETRIES = 3
+BACKOFF_S = 20
+
+
+def child():
+    """The actual measurement (runs in the watchdogged subprocess)."""
     import jax
-    import jax.numpy as jnp
     import numpy as np
     import optax
 
@@ -30,7 +53,7 @@ def main():
     from dtf_tpu.core.mesh import make_mesh
     from dtf_tpu.models import resnet
 
-    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+    batch = int(os.environ.get("DTF_BENCH_BATCH", "128"))
     mesh = make_mesh()
     n_chips = mesh.devices.size
 
@@ -62,13 +85,47 @@ def main():
 
     img_s = batch * n_steps / dt
     img_s_chip = img_s / n_chips
+    mfu = img_s_chip * RESNET50_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16_FLOPS
     print(json.dumps({
-        "metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+        "metric": METRIC,
         "value": round(img_s_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(img_s_chip / A100_PER_CHIP_IMG_S, 4),
+        "mfu": round(mfu, 4),
+        "backend": jax.default_backend(),
+        "n_chips": n_chips,
     }))
 
 
+def _parse(line):
+    # the result is the last stdout line that parses as our JSON
+    try:
+        result = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    if isinstance(result, dict) and result.get("metric") == METRIC:
+        return result
+    return None
+
+
+def main():
+    from _dtf_watchdog import child_argv, run_watchdogged
+
+    if len(sys.argv) > 1 and sys.argv[1] != "--child":
+        os.environ["DTF_BENCH_BATCH"] = sys.argv[1]
+    result, errors = run_watchdogged(
+        child_argv(os.path.abspath(__file__)), _parse,
+        timeout_s=CHILD_TIMEOUT_S, retries=RETRIES, backoff_s=BACKOFF_S,
+        env=dict(os.environ))
+    if result is None:
+        result = {"metric": METRIC, "value": 0, "unit": "images/sec/chip",
+                  "vs_baseline": 0, "error": "; ".join(errors)[:2000]}
+    print(json.dumps(result))
+    return 0  # structured error on stdout IS the contract; rc 0 so it lands
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child()
+    else:
+        sys.exit(main())

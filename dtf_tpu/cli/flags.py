@@ -51,3 +51,8 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000):
     flags.DEFINE_integer("log_every", 10, "steps between metric logs")
     flags.DEFINE_integer("grad_accum", 1, "gradient-accumulation microbatches")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
+    flags.DEFINE_integer("profile_steps", 0, "capture an XPlane profiler "
+                         "trace spanning this many steps (0 = off); written "
+                         "to <logdir>/profile")
+    flags.DEFINE_integer("profile_start", 10, "step at which the profiler "
+                         "trace window opens")

@@ -52,3 +52,16 @@ def setup(FLAGS):
         log.info("%s | %d process(es), chief=%s",
                  mesh_summary(mesh), info.num_processes, info.is_chief)
     return mesh, info
+
+
+def profiler_hooks(FLAGS):
+    """[ProfilerHook] from ``--profile_steps``/``--profile_start``, or []."""
+    if not getattr(FLAGS, "profile_steps", 0):
+        return []
+    import os
+
+    from dtf_tpu.hooks import ProfilerHook
+
+    return [ProfilerHook(os.path.join(FLAGS.logdir, "profile"),
+                         start_step=FLAGS.profile_start,
+                         num_steps=FLAGS.profile_steps)]

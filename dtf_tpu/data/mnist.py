@@ -21,6 +21,8 @@ from typing import Iterator
 
 import numpy as np
 
+from dtf_tpu.data.sharded import ShardedEpochs
+
 FILES = {
     "train_images": "train-images-idx3-ubyte",
     "train_labels": "train-labels-idx1-ubyte",
@@ -57,11 +59,11 @@ def available(data_dir: str) -> bool:
         for f in FILES.values())
 
 
-class MnistData:
+class MnistData(ShardedEpochs):
     """Shuffled epoch iterator with per-host sharding.
 
     Matches the reference loader's semantics: images flattened to 784 floats
-    in [0,1), labels int32, reshuffled every epoch. Each host sees a disjoint
+    in [0,1], labels int32, reshuffled every epoch. Each host sees a disjoint
     1/host_count slice of every epoch (the per-worker feed_dict successor).
     """
 
@@ -72,23 +74,9 @@ class MnistData:
         self.images = (images.reshape(len(images), -1) / 255.0).astype(
             np.float32)
         self.labels = labels.astype(np.int32)
-        if batch_size % host_count:
-            raise ValueError(
-                f"global batch {batch_size} not divisible by {host_count} hosts")
-        self.local_batch = batch_size // host_count
-        self.host_index = host_index
-        self.host_count = host_count
-        self.seed = seed
+        super().__init__(len(self.images), batch_size, seed=seed,
+                         host_index=host_index, host_count=host_count)
 
     def __iter__(self) -> Iterator[dict]:
-        epoch = 0
-        n = len(self.images)
-        while True:
-            order = np.random.default_rng(
-                np.random.SeedSequence([self.seed, epoch])).permutation(n)
-            shard = order[self.host_index::self.host_count]
-            for i in range(0, len(shard) - self.local_batch + 1,
-                           self.local_batch):
-                idx = shard[i:i + self.local_batch]
-                yield {"image": self.images[idx], "label": self.labels[idx]}
-            epoch += 1
+        for idx in self._indices():
+            yield {"image": self.images[idx], "label": self.labels[idx]}

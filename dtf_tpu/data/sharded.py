@@ -1,0 +1,57 @@
+"""Shared epoch/shuffle/host-shard iteration contract for array datasets.
+
+One implementation of the reference loader's ``next_batch`` semantics
+(reshuffle each epoch, disjoint per-host row shards) used by every
+array-backed dataset — :class:`dtf_tpu.data.mnist.MnistData` and the
+on-disk formats in :mod:`dtf_tpu.data.formats` — so the sharding rule can
+never silently diverge between loaders.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+import numpy as np
+
+
+def epoch_order(n: int, seed: int, epoch: int) -> np.ndarray:
+    """Deterministic per-epoch permutation (same on every host)."""
+    return np.random.default_rng(
+        np.random.SeedSequence([seed, epoch])).permutation(n)
+
+
+class ShardedEpochs:
+    """Base class: epoch reshuffle + ``order[host::count]`` row sharding +
+    ``local_batch`` windowing. Subclasses implement ``__iter__`` by drawing
+    index batches from :meth:`_indices`."""
+
+    def __init__(self, n_rows: int, batch_size: int, *, seed: int,
+                 host_index: int, host_count: int):
+        if batch_size % host_count:
+            raise ValueError(f"global batch {batch_size} not divisible by "
+                             f"{host_count} hosts")
+        self.n_rows = n_rows
+        self.local_batch = batch_size // host_count
+        self.seed = seed
+        self.host_index = host_index
+        self.host_count = host_count
+
+    def batches_per_epoch_uniform(self) -> int:
+        """Per-epoch batch count guaranteed IDENTICAL on every host.
+
+        ``order[host::count]`` gives early hosts one extra row when
+        ``n_rows % host_count != 0``; a full-epoch sweep driving a jitted
+        collective step must use the same iteration count everywhere or the
+        mesh deadlocks. This is the minimum any host can fill.
+        """
+        return (self.n_rows // self.host_count) // self.local_batch
+
+    def _indices(self) -> Iterator[np.ndarray]:
+        epoch = 0
+        while True:
+            order = epoch_order(self.n_rows, self.seed, epoch)
+            shard = order[self.host_index::self.host_count]
+            for i in range(0, len(shard) - self.local_batch + 1,
+                           self.local_batch):
+                yield shard[i:i + self.local_batch]
+            epoch += 1

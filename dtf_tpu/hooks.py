@@ -120,6 +120,7 @@ class EvalHook(Hook):
         self.writer = writer
         self.every_n = every_n
         self.place_batch = place_batch or (lambda b: b)
+        self._last_eval_step = None
 
     def _run(self, step, state):
         totals, n = {}, 0
@@ -131,13 +132,17 @@ class EvalHook(Hook):
         if n:
             self.writer.write_scalars(step,
                                       {k: v / n for k, v in totals.items()})
+        self._last_eval_step = step
 
     def after_step(self, step, state, metrics):
         if step % self.every_n == 0:
             self._run(step, state)
 
     def end(self, state):
-        self._run(int(state.step), state)
+        # after_step may already have evaluated at the final step; a second
+        # sweep would write duplicate scalars and double end-of-run cost.
+        if self._last_eval_step != int(state.step):
+            self._run(int(state.step), state)
 
 
 class ProfilerHook(Hook):

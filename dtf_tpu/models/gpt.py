@@ -135,6 +135,12 @@ class CausalSelfAttention(nn.Module):
                 raise ValueError(
                     f"decode mode takes one token per call, got T={t}")
             b = x.shape[0]
+            # Standard flax decode idiom: init() only ALLOCATES the cache
+            # (has_variable is False on the init trace, so no slot is
+            # written and cache_index stays 0); mutation happens only on
+            # real apply() calls. Without this guard, init's dummy token
+            # would occupy slot 0 and every later step would be off by one.
+            is_initialized = self.has_variable("cache", "cached_key")
             ck = self.variable("cache", "cached_key", jnp.zeros,
                                (b, cfg.heads, cfg.decode_len, d_head),
                                cfg.dtype)
@@ -147,11 +153,12 @@ class CausalSelfAttention(nn.Module):
             pos = idx[None]
             q = rope(q, pos, cfg.rope_theta)
             k = rope(k, pos, cfg.rope_theta)
-            ck.value = jax.lax.dynamic_update_slice_in_dim(
-                ck.value, k.astype(cfg.dtype), idx, axis=2)
-            cv.value = jax.lax.dynamic_update_slice_in_dim(
-                cv.value, v.astype(cfg.dtype), idx, axis=2)
-            ci.value = idx + 1
+            if is_initialized:
+                ck.value = jax.lax.dynamic_update_slice_in_dim(
+                    ck.value, k.astype(cfg.dtype), idx, axis=2)
+                cv.value = jax.lax.dynamic_update_slice_in_dim(
+                    cv.value, v.astype(cfg.dtype), idx, axis=2)
+                ci.value = idx + 1
             valid = jnp.arange(cfg.decode_len) <= idx           # [L]
             bias = jnp.where(valid, 0.0, -jnp.inf)[None, None, None, :]
             out = att.dense_attention(q, ck.value, cv.value, bias=bias)
@@ -266,9 +273,14 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    variables = model.init(jax.random.PRNGKey(0),
-                           jnp.zeros((b, 1), jnp.int32))
-    cache0 = variables["cache"]
+    # Build an all-zeros cache (index 0, no slots written) without
+    # materialising a throwaway parameter set: eval_shape traces init
+    # abstractly, then we allocate zeros matching the cache collection.
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((b, 1), jnp.int32)))
+    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                          shapes["cache"])
 
     def body(carry, t):
         cache, tok, rng = carry

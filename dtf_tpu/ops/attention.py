@@ -69,7 +69,8 @@ def _ring_block(scores: jax.Array, v_blk: jax.Array, m: jax.Array,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    kv_mask: Optional[jax.Array] = None, *,
                    axis_name: str = "seq", causal: bool = False,
-                   sm_scale: Optional[float] = None) -> jax.Array:
+                   sm_scale: Optional[float] = None,
+                   skip_masked_blocks: bool = True) -> jax.Array:
     """Ring attention over a sequence-sharded mesh axis (call inside shard_map).
 
     Per-shard shapes [B,H,Tl,D] where Tl = T/num_shards; shard i holds global
@@ -80,9 +81,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 
     ``kv_mask`` [B,Tl] (True = valid key) travels the ring alongside K/V, so
     padded positions are excluded exactly as in dense attention. Causal
-    masking uses global positions; future blocks contribute nothing. Compute
-    for fully-masked blocks is not skipped in this v1 — a latency note, not a
-    correctness one.
+    masking uses global positions; incoming blocks that lie entirely above
+    the diagonal (src > idx) are skipped with ``lax.cond`` — their matmuls
+    never run, cutting total causal FLOPs roughly in half at large ring
+    sizes. (The cond predicate varies per device; that is fine because the
+    skipped branch contains no collectives — the ppermutes stay outside.)
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -118,7 +121,13 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         mask_blk = jax.lax.ppermute(mask_blk, axis_name, perm)
         src = (idx - step) % n
-        m, l, o = fold(k_blk, v_blk, mask_blk, src, m, l, o)
+        if causal and skip_masked_blocks:
+            m, l, o = jax.lax.cond(
+                src <= idx, fold,
+                lambda _k, _v, _m, _s, m, l, o: (m, l, o),
+                k_blk, v_blk, mask_blk, src, m, l, o)
+        else:
+            m, l, o = fold(k_blk, v_blk, mask_blk, src, m, l, o)
         return (k_blk, v_blk, mask_blk, m, l, o), None
 
     if n > 1:

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Long-context attention benchmark: ring vs dense, causal-skip on vs off.
+
+VERDICT r1 weak-spot #5 asked for measured evidence that the long-context
+path does not waste FLOPs. This times, at several sequence lengths:
+
+- dense causal attention (the O(T^2) single-device baseline),
+- ring attention over an 8-way ``seq`` mesh WITHOUT causal block skipping,
+- ring attention WITH skipping (the default) — incoming blocks entirely
+  above the diagonal never run their matmuls.
+
+On real hardware the 8 ring shards run concurrently; under the CPU
+8-virtual-device sim they share host cores, so *total* compute is what the
+wall clock sees — which is exactly the quantity block-skipping halves. The
+artifact `ATTN_BENCH.json` records medians per (impl, seq).
+
+Runs itself under a clean 8-device virtual-CPU env (re-exec pattern shared
+with tests/conftest.py).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from _dtf_env import cpu_sim_env, is_cpu_sim  # noqa: E402
+
+if (not is_cpu_sim(os.environ, 8)
+        and os.environ.get("_DTF_ATTN_BENCH_REEXEC") != "1"):
+    env = cpu_sim_env(8, os.environ)
+    env["_DTF_ATTN_BENCH_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from dtf_tpu.core.mesh import MeshConfig, make_mesh
+from dtf_tpu.ops import attention as att
+
+
+def timed(fn, *args, reps=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main():
+    mesh = make_mesh(MeshConfig(data=1, seq=8))
+    b, h, d = 1, 8, 64
+    results = {"device_count": jax.device_count(),
+               "backend": jax.default_backend(), "rows": []}
+
+    for t in (4096, 8192, 16384):
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, h, t, d),
+                              jnp.float32)
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, h, t, d),
+                              jnp.float32)
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, h, t, d),
+                              jnp.float32)
+
+        dense = jax.jit(functools.partial(att.dense_attention, causal=True))
+
+        def ring(skip):
+            spec = P(None, None, "seq", None)
+            fn = functools.partial(att.ring_attention, causal=True,
+                                   skip_masked_blocks=skip)
+            sm = jax.shard_map(
+                lambda q, k, v: fn(q, k, v),
+                mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+            return jax.jit(sm)
+
+        t_dense = timed(dense, q, k, v)
+        t_ring_noskip = timed(ring(False), q, k, v)
+        t_ring_skip = timed(ring(True), q, k, v)
+        row = {"seq": t, "dense_s": round(t_dense, 4),
+               "ring_noskip_s": round(t_ring_noskip, 4),
+               "ring_skip_s": round(t_ring_skip, 4),
+               "skip_speedup": round(t_ring_noskip / t_ring_skip, 3)}
+        results["rows"].append(row)
+        print(row)
+
+    with open(os.path.join(ROOT, "ATTN_BENCH.json"), "w") as f:
+        json.dump(results, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,7 @@ def main(argv):
     import optax
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.cli.launch import profiler_hooks, setup
     from dtf_tpu.core import train as tr
     from dtf_tpu.data import mnist as mnist_data
     from dtf_tpu.data.synthetic import SyntheticData
@@ -90,29 +90,48 @@ def main(argv):
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
-               StopAtStepHook(FLAGS.train_steps)],
+               StopAtStepHook(FLAGS.train_steps),
+               *profiler_hooks(FLAGS)],
         checkpointer=ckpt)
     state = trainer.fit(state, iter(data))
 
     # final eval (the reference's script printed test accuracy at the end):
-    # real data → the t10k test split; synthetic → a held-out step index.
-    if isinstance(data, SyntheticData):
-        eval_batch = data.batch(10_000_019)
-    else:
-        eval_batch = next(iter(mnist_data.MnistData(
-            FLAGS.data_dir, FLAGS.batch_size, split="test", seed=FLAGS.seed,
-            host_index=info.process_id, host_count=info.num_processes)))
-    eval_step = tr.make_eval_step(mnist_model.make_eval(model), mesh,
-                                  shardings)
+    # real data → the FULL t10k test split, averaged over batches; synthetic
+    # → a held-out step index.
+    import itertools
+
     from dtf_tpu.core.comms import shard_batch
 
-    eval_metrics = eval_step(state, shard_batch(eval_batch, mesh))
-    writer.write_scalars(int(state.step),
-                         {k: float(v) for k, v in eval_metrics.items()})
+    if isinstance(data, SyntheticData):
+        eval_batches = [data.batch(10_000_019)]
+    else:
+        test = mnist_data.MnistData(
+            FLAGS.data_dir, FLAGS.batch_size, split="test", seed=FLAGS.seed,
+            host_index=info.process_id, host_count=info.num_processes)
+        # uniform across hosts: every process must drive the jitted eval
+        # step the same number of times or the mesh deadlocks.
+        eval_batches = itertools.islice(iter(test),
+                                        test.batches_per_epoch_uniform())
+    eval_step = tr.make_eval_step(mnist_model.make_eval(model), mesh,
+                                  shardings)
+    totals, n_eval = {}, 0
+    for eval_batch in eval_batches:
+        m = eval_step(state, shard_batch(eval_batch, mesh))
+        for k, v in m.items():
+            totals[k] = totals.get(k, 0.0) + float(v)
+        n_eval += 1
+    if n_eval:
+        eval_metrics = {k: v / n_eval for k, v in totals.items()}
+        writer.write_scalars(int(state.step), eval_metrics)
+        summary = f"eval_accuracy={eval_metrics['eval_accuracy']:.4f}"
+    else:
+        absl_logging.warning(
+            "test split smaller than one uniform per-host batch "
+            "(batch_size too large for the host count); skipping final eval")
+        summary = "eval_accuracy=n/a"
     writer.close()
     ckpt.close()
-    print(f"done: step={int(state.step)} "
-          f"eval_accuracy={float(eval_metrics['eval_accuracy']):.4f}")
+    print(f"done: step={int(state.step)} {summary}")
 
 
 if __name__ == "__main__":

@@ -16,7 +16,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from absl import app, flags
+from absl import app, flags, logging as absl_logging
 
 from dtf_tpu.cli import flags as dflags
 
@@ -39,7 +39,7 @@ def main(argv):
     from jax.sharding import PartitionSpec as P
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.cli.launch import profiler_hooks, setup
     from dtf_tpu.core import train as tr
     from dtf_tpu.core.comms import batch_shardings_for, shard_batch
     from dtf_tpu.data.synthetic import SyntheticData
@@ -69,10 +69,20 @@ def main(argv):
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=gpt.tp_rules, zero1=FLAGS.zero1)
 
-    data = SyntheticData("gpt", FLAGS.batch_size, seed=FLAGS.seed,
-                         seq_len=FLAGS.seq_len, vocab_size=cfg.vocab_size,
-                         host_index=info.process_id,
-                         host_count=info.num_processes)
+    from dtf_tpu.data import formats
+
+    data = formats.detect_token_data(
+        FLAGS.data_dir, FLAGS.batch_size, FLAGS.seq_len, mode="clm",
+        vocab_size=cfg.vocab_size, seed=FLAGS.seed,
+        host_index=info.process_id, host_count=info.num_processes)
+    if data is None:
+        if FLAGS.data_dir:
+            absl_logging.warning("no token .bin in %s; using synthetic data",
+                                 FLAGS.data_dir)
+        data = SyntheticData("gpt", FLAGS.batch_size, seed=FLAGS.seed,
+                             seq_len=FLAGS.seq_len, vocab_size=cfg.vocab_size,
+                             host_index=info.process_id,
+                             host_count=info.num_processes)
     kwargs = {}
     spec = None
     if sp:
@@ -89,7 +99,8 @@ def main(argv):
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
-               StopAtStepHook(FLAGS.train_steps)],
+               StopAtStepHook(FLAGS.train_steps),
+               *profiler_hooks(FLAGS)],
         checkpointer=ckpt,
         place_batch=lambda b: shard_batch(b, mesh, spec=spec))
     state = trainer.fit(state, iter(data))

@@ -15,7 +15,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from absl import app, flags
+from absl import app, flags, logging as absl_logging
 
 from dtf_tpu.cli import flags as dflags
 
@@ -36,7 +36,7 @@ def main(argv):
     import optax
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.cli.launch import profiler_hooks, setup
     from dtf_tpu.core import train as tr
     from dtf_tpu.data.synthetic import SyntheticData
     from dtf_tpu.core.comms import shard_batch
@@ -65,28 +65,61 @@ def main(argv):
         resnet.make_loss(model, weight_decay=FLAGS.weight_decay), tx, mesh,
         shardings, grad_accum=FLAGS.grad_accum)
 
-    data = SyntheticData(kind, FLAGS.batch_size, seed=FLAGS.seed,
-                         host_index=info.process_id,
-                         host_count=info.num_processes)
+    from dtf_tpu.data import formats
+
+    data = formats.detect_image_data(
+        FLAGS.data_dir, FLAGS.batch_size, seed=FLAGS.seed,
+        host_index=info.process_id, host_count=info.num_processes)
+    if data is None:
+        if FLAGS.data_dir:
+            absl_logging.warning("no images.npy/labels.npy or CIFAR .bin "
+                                 "batches in %s; using synthetic data",
+                                 FLAGS.data_dir)
+        data = SyntheticData(kind, FLAGS.batch_size, seed=FLAGS.seed,
+                             host_index=info.process_id,
+                             host_count=info.num_processes)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
     eval_step = tr.make_eval_step(resnet.make_eval(model), mesh, shardings)
-    eval_data = SyntheticData(kind, FLAGS.batch_size, seed=FLAGS.seed + 1,
-                              host_index=info.process_id,
-                              host_count=info.num_processes)
-    eval_hook = EvalHook(
-        eval_step,
-        lambda: (eval_data.batch(10_000_000 + i) for i in range(4)),
-        writer, FLAGS.eval_every or FLAGS.train_steps,
-        place_batch=lambda b: shard_batch(b, mesh))
+    using_real_data = not isinstance(data, SyntheticData)
+    if using_real_data:
+        # score on the matching held-out split; if the data_dir has none,
+        # drop eval rather than report numbers from unrelated tensors.
+        eval_data = formats.detect_image_eval_data(
+            FLAGS.data_dir, FLAGS.batch_size, seed=FLAGS.seed,
+            host_index=info.process_id, host_count=info.num_processes)
+        if eval_data is None:
+            absl_logging.warning(
+                "no eval split (test_images.npy / test_batch.bin) in %s; "
+                "skipping periodic eval", FLAGS.data_dir)
+            batches_fn = None
+        else:
+            import itertools
+
+            n_eval_batches = eval_data.batches_per_epoch_uniform()
+            batches_fn = lambda: itertools.islice(  # noqa: E731
+                iter(eval_data), n_eval_batches)
+    else:
+        eval_data = SyntheticData(kind, FLAGS.batch_size, seed=FLAGS.seed + 1,
+                                  host_index=info.process_id,
+                                  host_count=info.num_processes)
+        batches_fn = lambda: (eval_data.batch(10_000_000 + i)  # noqa: E731
+                              for i in range(4))
+    eval_hook = None
+    if batches_fn is not None:
+        eval_hook = EvalHook(
+            eval_step, batches_fn,
+            writer, FLAGS.eval_every or FLAGS.train_steps,
+            place_batch=lambda b: shard_batch(b, mesh))
     trainer = Trainer(
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
-               eval_hook,
-               StopAtStepHook(FLAGS.train_steps)],
+               *([eval_hook] if eval_hook else []),
+               StopAtStepHook(FLAGS.train_steps),
+               *profiler_hooks(FLAGS)],
         checkpointer=ckpt)
     state = trainer.fit(state, iter(data))
     writer.close()

@@ -9,7 +9,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from absl import app, flags
+from absl import app, flags, logging as absl_logging
 
 from dtf_tpu.cli import flags as dflags
 
@@ -28,7 +28,7 @@ def main(argv):
     import optax
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import setup
+    from dtf_tpu.cli.launch import profiler_hooks, setup
     from dtf_tpu.core import train as tr
     from dtf_tpu.data.synthetic import SyntheticData
     from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
@@ -47,10 +47,20 @@ def main(argv):
     step = tr.make_train_step(widedeep.make_loss(model), tx, mesh, shardings,
                               grad_accum=FLAGS.grad_accum)
 
-    data = SyntheticData("widedeep", FLAGS.batch_size, seed=FLAGS.seed,
-                         hash_buckets=FLAGS.hash_buckets,
-                         host_index=info.process_id,
-                         host_count=info.num_processes)
+    from dtf_tpu.data import formats
+
+    data = formats.detect_criteo_data(
+        FLAGS.data_dir, FLAGS.batch_size, hash_buckets=FLAGS.hash_buckets,
+        seed=FLAGS.seed, host_index=info.process_id,
+        host_count=info.num_processes)
+    if data is None:
+        if FLAGS.data_dir:
+            absl_logging.warning("no criteo csv/tsv in %s; using synthetic "
+                                 "data", FLAGS.data_dir)
+        data = SyntheticData("widedeep", FLAGS.batch_size, seed=FLAGS.seed,
+                             hash_buckets=FLAGS.hash_buckets,
+                             host_index=info.process_id,
+                             host_count=info.num_processes)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
@@ -59,7 +69,8 @@ def main(argv):
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
-               StopAtStepHook(FLAGS.train_steps)],
+               StopAtStepHook(FLAGS.train_steps),
+               *profiler_hooks(FLAGS)],
         checkpointer=ckpt)
     state = trainer.fit(state, iter(data))
     writer.close()

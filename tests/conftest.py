@@ -12,29 +12,19 @@ working from any shell without wrapper scripts.
 import os
 import sys
 
-_WANT_FLAG = "--xla_force_host_platform_device_count=8"
-
-
-def _env_ok() -> bool:
-    return (
-        not os.environ.get("PALLAS_AXON_POOL_IPS")
-        and os.environ.get("JAX_PLATFORMS") == "cpu"
-        and _WANT_FLAG in os.environ.get("XLA_FLAGS", "")
-    )
-
-
-if not _env_ok() and os.environ.get("_DTF_TPU_TEST_REEXEC") != "1":
-    env = dict(os.environ)
-    env["_DTF_TPU_TEST_REEXEC"] = "1"
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + _WANT_FLAG).strip()
-    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
-
-# Repo root on sys.path so `import dtf_tpu` works without installation.
+# Repo root on sys.path so `import dtf_tpu` (and _dtf_env) work without
+# installation.
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _ROOT not in sys.path:
     sys.path.insert(0, _ROOT)
+
+from _dtf_env import cpu_sim_env, is_cpu_sim  # noqa: E402
+
+if (not is_cpu_sim(os.environ, 8)
+        and os.environ.get("_DTF_TPU_TEST_REEXEC") != "1"):
+    env = cpu_sim_env(8, os.environ)
+    env["_DTF_TPU_TEST_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
 
 import jax  # noqa: E402
 import pytest  # noqa: E402

