@@ -166,10 +166,6 @@ class CausalSelfAttention(nn.Module):
             return nn.Dense(cfg.d_model, dtype=cfg.dtype,
                             param_dtype=jnp.float32, name="attn_out")(out)
 
-        positions = jnp.arange(t)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
-
         impl = cfg.attn_impl
         seq_sharded = (self.mesh is not None
                        and self.mesh.shape.get("seq", 1) > 1)
@@ -180,7 +176,23 @@ class CausalSelfAttention(nn.Module):
                 impl = "flash"
             else:
                 impl = "dense"
-        if impl == "ring":
+
+        if impl == "zigzag" and seq_sharded:
+            # rows arrive in the zigzag layout (the data layer permuted
+            # them; see zigzag_batch) — RoPE needs their GLOBAL positions,
+            # which are exactly the permutation values.
+            positions = att.zigzag_permutation(t, self.mesh.shape["seq"])
+        else:
+            positions = jnp.arange(t)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        if impl == "zigzag":
+            if seq_sharded:
+                out = att.zigzag_ring_attention_sharded(q, k, v, self.mesh)
+            else:
+                out = att.dense_attention(q, k, v, causal=True)
+        elif impl == "ring":
             out = att.ring_attention_sharded(q, k, v, self.mesh, causal=True)
         elif impl == "flash":
             out = _flash_sharded(q, k, v, self.mesh,
@@ -241,6 +253,24 @@ class GPT(nn.Module):
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="lm_head")(x)
         return logits
+
+
+def zigzag_batch(batch: dict, seq_shards: int) -> dict:
+    """Permute a CLM batch into the zigzag layout (host-side numpy).
+
+    With ``attn_impl="zigzag"`` the whole model runs in the permuted order
+    (per-token CE is order-invariant; RoPE gets the true global positions
+    inside the attention module), so permuting input_ids and labels at the
+    data layer is the ONLY change training needs.
+    """
+    import numpy as np
+
+    from dtf_tpu.ops.attention import zigzag_permutation
+
+    t = batch["input_ids"].shape[1]
+    perm = np.asarray(zigzag_permutation(t, seq_shards))
+    return {**batch, "input_ids": batch["input_ids"][:, perm],
+            "labels": batch["labels"][:, perm]}
 
 
 def make_init(cfg: GPTConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):

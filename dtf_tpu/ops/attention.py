@@ -138,6 +138,152 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def zigzag_permutation(t: int, n_shards: int) -> "jax.Array":
+    """Natural→zigzag row permutation for load-balanced causal rings.
+
+    The sequence is cut into ``2n`` chunks; shard ``i`` holds chunks
+    ``(i, 2n-1-i)`` concatenated. Under causal masking this balances work:
+    plain contiguous sharding gives shard 0 almost nothing to do and shard
+    n-1 everything (the ring's wall-clock is the slowest shard), while the
+    zigzag pairing gives every shard the same number of live blocks each
+    ring step — the standard "zigzag"/striped context-parallel layout.
+
+    Returns ``perm`` with ``zigzag[j] = natural[perm[j]]``; ``perm`` is also
+    exactly the global position of zigzag row ``j`` (feed it to RoPE).
+    Requires ``t % (2 * n_shards) == 0``.
+    """
+    if t % (2 * n_shards):
+        raise ValueError(f"seq len {t} not divisible by 2*{n_shards} chunks")
+    c = t // (2 * n_shards)
+    chunks = []
+    for i in range(n_shards):
+        chunks.append(jnp.arange(i * c, (i + 1) * c))
+        chunks.append(jnp.arange((2 * n_shards - 1 - i) * c,
+                                 (2 * n_shards - i) * c))
+    return jnp.concatenate(chunks)
+
+
+def inverse_permutation(perm: jax.Array) -> jax.Array:
+    return jnp.argsort(perm)
+
+
+def zigzag_ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                          axis_name: str = "seq",
+                          sm_scale: Optional[float] = None) -> jax.Array:
+    """Load-balanced CAUSAL ring attention (call inside shard_map).
+
+    Inputs are in the zigzag layout (:func:`zigzag_permutation`): each shard
+    holds [B,H,2c,D] = chunks (idx, 2n-1-idx) concatenated. Per ring step a
+    shard receives one neighbor pair and folds the live quadrants:
+
+    - q_hi × kv_lo: ALWAYS live and always unmasked (every high chunk is
+      causally after every low chunk) — the balanced baseline work;
+    - q_lo × kv_lo: live iff src <= idx (diagonal step masks in-chunk);
+    - q_hi × kv_hi: live iff src >= idx (ditto);
+    - q_lo × kv_hi: never live — never computed.
+
+    So every shard folds exactly 2 of 4 quadrants per off-diagonal step
+    (~2x FLOP cut vs dense folds AND no straggler shard), with per-quadrant
+    online-softmax accumulators in f32. Full sequences only (no kv_mask);
+    use :func:`ring_attention` for padded batches.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, t_l, d = q.shape
+    if t_l % 2:
+        raise ValueError(f"zigzag shard length {t_l} must be even")
+    c = t_l // 2
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    lo_pos = idx * c + jnp.arange(c)
+    hi_pos = (2 * n - 1 - idx) * c + jnp.arange(c)
+    q_lo, q_hi = q[:, :, :c], q[:, :, c:]
+
+    def scores_of(qh, kh, qpos, kpos, masked):
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       preferred_element_type=jnp.float32) * scale
+        if masked:
+            s = jnp.where(qpos[:, None] >= kpos[None, :], s, -jnp.inf)
+        return s
+
+    def fold_quadrant(qh, kh, vh, qpos, kpos, masked, m, l, o):
+        return _ring_block(scores_of(qh, kh, qpos, kpos, masked), vh, m, l, o)
+
+    zero_lo = q_lo.astype(jnp.float32) * 0.0
+    zero_hi = q_hi.astype(jnp.float32) * 0.0
+    st = dict(
+        m_lo=zero_lo[..., 0] - jnp.inf, l_lo=zero_lo[..., 0], o_lo=zero_lo,
+        m_hi=zero_hi[..., 0] - jnp.inf, l_hi=zero_hi[..., 0], o_hi=zero_hi)
+
+    def fold_pair(k_blk, v_blk, src, st):
+        k_lo, k_hi = k_blk[:, :, :c], k_blk[:, :, c:]
+        v_lo, v_hi = v_blk[:, :, :c], v_blk[:, :, c:]
+        klo_pos = src * c + jnp.arange(c)
+        khi_pos = (2 * n - 1 - src) * c + jnp.arange(c)
+
+        # q_hi × kv_lo: always live, never masked (hi chunks follow all
+        # lo chunks). Masking would be a no-op; skip building it.
+        m_hi, l_hi, o_hi = fold_quadrant(
+            q_hi, k_lo, v_lo, hi_pos, klo_pos, False,
+            st["m_hi"], st["l_hi"], st["o_hi"])
+
+        # q_lo × kv_lo: live iff src <= idx; in-chunk mask only matters on
+        # the diagonal but the position compare is cheap — always apply.
+        m_lo, l_lo, o_lo = jax.lax.cond(
+            src <= idx,
+            lambda m, l, o: fold_quadrant(q_lo, k_lo, v_lo, lo_pos, klo_pos,
+                                          True, m, l, o),
+            lambda m, l, o: (m, l, o),
+            st["m_lo"], st["l_lo"], st["o_lo"])
+
+        # q_hi × kv_hi: live iff src >= idx.
+        m_hi, l_hi, o_hi = jax.lax.cond(
+            src >= idx,
+            lambda m, l, o: fold_quadrant(q_hi, k_hi, v_hi, hi_pos, khi_pos,
+                                          True, m, l, o),
+            lambda m, l, o: (m, l, o),
+            m_hi, l_hi, o_hi)
+        return dict(m_lo=m_lo, l_lo=l_lo, o_lo=o_lo,
+                    m_hi=m_hi, l_hi=l_hi, o_hi=o_hi)
+
+    st = fold_pair(k, v, idx, st)
+
+    def body(carry, step):
+        k_blk, v_blk, st = carry
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        st = fold_pair(k_blk, v_blk, (idx - step) % n, st)
+        return (k_blk, v_blk, st), None
+
+    if n > 1:
+        (_, _, st), _ = jax.lax.scan(body, (k, v, st), jnp.arange(1, n))
+
+    out_lo = st["o_lo"] / jnp.maximum(st["l_lo"], 1e-30)[..., None]
+    out_hi = st["o_hi"] / jnp.maximum(st["l_hi"], 1e-30)[..., None]
+    return jnp.concatenate([out_lo, out_hi], axis=2).astype(q.dtype)
+
+
+def zigzag_ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                                  mesh: Mesh, *,
+                                  sm_scale: Optional[float] = None
+                                  ) -> jax.Array:
+    """Global-array wrapper: shard_map(zigzag_ring_attention) over ``seq``.
+
+    Expects [B,H,T,D] already PERMUTED into the zigzag layout (rows ordered
+    by :func:`zigzag_permutation`(T, seq_shards)), B on ``data``, H on
+    ``model``, T on ``seq``. Falls back to dense causal attention when the
+    seq axis is trivial (n=1 ⇒ the zigzag layout is the natural order).
+    """
+    seq_shards = mesh.shape.get("seq", 1)
+    if seq_shards == 1:
+        return dense_attention(q, k, v, causal=True, sm_scale=sm_scale)
+    spec = P("data", "model", "seq", None)
+    fn = functools.partial(zigzag_ring_attention, sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, kv_mask: Optional[jax.Array] = None,
                            *, causal: bool = False,

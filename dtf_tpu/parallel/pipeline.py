@@ -150,3 +150,140 @@ def stage_param_specs(params: PyTree, axis_name: str = AXIS_PIPE) -> PyTree:
     """P('pipe') spec tree for a stacked-stage param tree (for train-state
     sharding rules / create_train_state param_rules bypass)."""
     return jax.tree.map(lambda _: P(axis_name), params)
+
+
+def interleaved_stage_order(n_devices: int, v_per_device: int) -> list[int]:
+    """Stack-row order for the interleaved schedule.
+
+    Device ``i`` must hold logical stages ``{i, n+i, 2n+i, ...}`` (the
+    Megatron interleaved assignment), but a P('pipe')-sharded stack gives
+    each device CONTIGUOUS rows. So the stack is laid out device-major:
+    row ``i*V + v`` holds logical stage ``v*n + i``. Returns that logical
+    order; use :func:`reorder_stages` to permute a logically-ordered stack.
+    """
+    return [v * n_devices + i
+            for i in range(n_devices) for v in range(v_per_device)]
+
+
+def reorder_stages(stacked: PyTree, n_devices: int,
+                   v_per_device: int) -> PyTree:
+    """Permute a logically-ordered [S, ...] stack into interleaved layout."""
+    import numpy as np
+
+    order = np.asarray(interleaved_stage_order(n_devices, v_per_device))
+    return jax.tree.map(lambda t: t[order], stacked)
+
+
+def pipeline_interleaved(
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    n_microbatches: int,
+    mesh: Mesh,
+    v_per_device: int,
+    *,
+    axis_name: str = AXIS_PIPE,
+    batch_spec: P = P("data"),
+):
+    """Interleaved (circular) pipeline schedule — the Megatron-style
+    bubble-reduction over :func:`pipeline_spmd`.
+
+    Each device holds ``V = v_per_device`` model chunks (logical stage
+    ``v*n + i`` for device ``i``; total S = n*V finer-grained stages), and
+    the activation circles the device ring V times per microbatch. The
+    schedule is closed-form: device ``i`` runs (microbatch m, chunk v) at
+    tick ``t = i + (m mod n) + n*(v + V*(m//n))`` — a unique (m, v) per
+    (i, t), so every device does exactly one chunk per tick in steady state
+    and the fill/drain bubble shrinks from (n-1)/M to ~(n-1)/(V*M) of total
+    work at the cost of V x more ppermute hops (cheap: neighbor ICI).
+
+    ``stacked_params``: [n*V, ...] in INTERLEAVED row order (see
+    :func:`reorder_stages`), sharded P('pipe'). ``n_microbatches`` must be
+    a multiple of the pipe-axis size. Gradients flow through scan+ppermute
+    like the GPipe path; wrap ``stage_fn`` in ``jax.checkpoint`` to trade
+    recompute for activation memory.
+    """
+    n_stages = mesh.shape.get(axis_name, 1)
+    V = v_per_device
+
+    def sharded(params, x):
+        if x.shape[0] % n_microbatches:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by n_microbatches="
+                f"{n_microbatches}")
+        if n_microbatches % max(n_stages, 1):
+            raise ValueError(
+                f"n_microbatches={n_microbatches} must be a multiple of the "
+                f"'{axis_name}' axis size {n_stages} for the interleaved "
+                "schedule")
+        n_stacked = jax.tree.leaves(params)[0].shape[0]
+        if n_stacked != n_stages * V:
+            raise ValueError(
+                f"stage stack has {n_stacked} rows but needs "
+                f"{n_stages} devices x {V} chunks = {n_stages * V}")
+        if n_stages == 1:
+            out = x
+            for v in range(V):
+                out = stage_fn(jax.tree.map(lambda t: t[v], params), out)
+            return out
+
+        m_count = n_microbatches
+        micro = x.reshape((m_count, x.shape[0] // m_count) + x.shape[1:])
+        total_ticks = ((n_stages - 1) + ((m_count - 1) % n_stages)
+                       + n_stages * ((V - 1) + V * ((m_count - 1)
+                                                    // n_stages)) + 1)
+
+        def body(params, xs):
+            xs = jax.lax.pcast(xs, (axis_name,), to="varying")
+            p_local = jax.tree.map(lambda t: t, params)   # [V, ...] shard
+            idx = jax.lax.axis_index(axis_name)
+            ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+            def step(carry, t):
+                act, out = carry
+                # closed-form schedule decode for (this device, tick t)
+                u = t - idx
+                active = u >= 0
+                uc = jnp.maximum(u, 0)
+                m_mod = uc % n_stages
+                w = uc // n_stages           # = v + V * group
+                v = w % V
+                g = w // V
+                m = g * n_stages + m_mod
+                active = active & (m < m_count)
+                m_c = jnp.clip(m, 0, m_count - 1)
+
+                x_t = jax.lax.dynamic_index_in_dim(xs, m_c, 0,
+                                                   keepdims=False)
+                inp = jnp.where((idx == 0) & (v == 0), x_t, act)
+                stage_p = jax.tree.map(
+                    lambda t_: jax.lax.dynamic_index_in_dim(
+                        t_, v, 0, keepdims=False), p_local)
+                y = stage_fn(stage_p, inp)
+
+                # final-chunk output on the last device → result buffer
+                write = active & (idx == n_stages - 1) & (v == V - 1)
+                cur = jax.lax.dynamic_index_in_dim(out, m_c, 0,
+                                                   keepdims=False)
+                out = jax.lax.dynamic_update_index_in_dim(
+                    out, jnp.where(write, y, cur), m_c, 0)
+                # everything rides the wraparound ring; the receiver's
+                # schedule decode tells it whether the arrival is live
+                act = jax.lax.ppermute(y, axis_name, ring)
+                return (act, out), None
+
+            act0 = jnp.zeros_like(xs[0])
+            out0 = jnp.zeros_like(xs)
+            (_, out), _ = jax.lax.scan(step, (act0, out0),
+                                       jnp.arange(total_ticks))
+            # result lives on the last device only; replicate over pipe.
+            # psum would double-count nothing (zeros elsewhere).
+            return jax.lax.psum(out, axis_name)
+
+        p_spec = stage_param_specs(params, axis_name)
+        micro_spec = P(None, *batch_spec)
+        y = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(p_spec, micro_spec), out_specs=micro_spec,
+        )(params, micro)
+        return y.reshape(x.shape[0:1] + y.shape[2:])
+
+    return sharded

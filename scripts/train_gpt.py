@@ -29,6 +29,8 @@ flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
 flags.DEFINE_integer("moe_every", 0, "every k-th block uses Switch-MoE "
                      "(0 = dense)")
 flags.DEFINE_boolean("remat", False, "jax.checkpoint each block")
+flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
+                    "zigzag (load-balanced causal ring; needs mesh_seq>1)")
 FLAGS = flags.FLAGS
 
 
@@ -56,7 +58,7 @@ def main(argv):
     import dataclasses
 
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,
-                              remat=FLAGS.remat)
+                              remat=FLAGS.remat, attn_impl=FLAGS.attn_impl)
     # the model needs the mesh for ring attention (seq axis) AND for the
     # shard_map'd flash kernel (model axis) — pass it unconditionally.
     model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
@@ -102,7 +104,10 @@ def main(argv):
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt,
-        place_batch=lambda b: shard_batch(b, mesh, spec=spec))
+        place_batch=lambda b: shard_batch(
+            gpt.zigzag_batch(b, mesh.shape["seq"])
+            if (sp and FLAGS.attn_impl == "zigzag") else b,
+            mesh, spec=spec))
     state = trainer.fit(state, iter(data))
     writer.close()
     ckpt.close()

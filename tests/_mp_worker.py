@@ -39,7 +39,8 @@ def main(task_index: int, num_workers: int, port: int) -> None:
         mnist.make_init(model), tx, jax.random.PRNGKey(0), mesh)
     step = tr.make_train_step(mnist.make_loss(model), tx, mesh, shardings)
 
-    data = SyntheticData("mnist", 16, seed=0, host_index=info.process_id,
+    data = SyntheticData("mnist", 8 * num_workers, seed=0,
+                         host_index=info.process_id,
                          host_count=info.num_processes)
     losses = []
     for i in range(5):

@@ -42,7 +42,7 @@ def _env():
     return env
 
 
-def _reference_losses():
+def _reference_losses(n_hosts: int = 2):
     """Single-process run on the same global batches (hosts concatenated)."""
     import jax
     import optax
@@ -53,18 +53,19 @@ def _reference_losses():
     from dtf_tpu.data.synthetic import SyntheticData
     from dtf_tpu.models import mnist
 
-    mesh = make_mesh(MeshConfig(data=2), devices=jax.devices()[:2])
+    mesh = make_mesh(MeshConfig(data=n_hosts),
+                     devices=jax.devices()[:n_hosts])
     model = mnist.make_model("softmax")
     tx = optax.sgd(0.1)
     state, shardings = tr.create_train_state(
         mnist.make_init(model), tx, jax.random.PRNGKey(0), mesh)
     step = tr.make_train_step(mnist.make_loss(model), tx, mesh, shardings)
-    streams = [SyntheticData("mnist", 16, seed=0, host_index=h, host_count=2)
-               for h in range(2)]
+    streams = [SyntheticData("mnist", 8 * n_hosts, seed=0, host_index=h,
+                             host_count=n_hosts) for h in range(n_hosts)]
     losses = []
     for i in range(5):
-        b0, b1 = streams[0].batch(i), streams[1].batch(i)
-        batch = {k: np.concatenate([b0[k], b1[k]]) for k in b0}
+        bs = [s.batch(i) for s in streams]
+        batch = {k: np.concatenate([b[k] for b in bs]) for k in bs[0]}
         state, metrics = step(state, shard_batch(batch, mesh))
         losses.append(float(metrics["loss"]))
     return losses
@@ -96,6 +97,30 @@ def test_two_process_training_matches_single_process(tmp_path):
     np.testing.assert_allclose(l0, l1, rtol=0, atol=0)
     # and it equals the single-process run on the concatenated batches
     np.testing.assert_allclose(l0, _reference_losses(), rtol=1e-5)
+
+
+def test_four_process_training_matches_single_process(tmp_path):
+    """The reference's README story is N processes (SURVEY.md §1 L6);
+    prove the collapse path beyond 2: four coordination-service processes,
+    one device each, bitwise-identical losses matching a single-process
+    4-device run."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(i), "4", str(port)],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(4)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=360)
+        outs.append(out)
+        assert p.returncode == 0, out[-2000:]
+    losses = [_parse_losses(o) for o in outs]
+    for l in losses[1:]:
+        np.testing.assert_allclose(losses[0], l, rtol=0, atol=0)
+    np.testing.assert_allclose(losses[0], _reference_losses(4), rtol=1e-5)
 
 
 def _parse_losses(out):
