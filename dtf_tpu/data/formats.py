@@ -242,7 +242,7 @@ class CriteoCsvData(ShardedEpochs):
             if not cands:
                 raise FileNotFoundError(f"no criteo csv/tsv in {path}")
             path = cands[0]
-        cache = self._cache_dir(path)
+        cache = self._cache_dir(path, hash_buckets, num_sparse)
         meta_path = os.path.join(cache, "meta.json")
         want_meta = {"version": 2,  # v2: CRLF-stripping parser
                      "mtime": os.path.getmtime(path),
@@ -267,17 +267,23 @@ class CriteoCsvData(ShardedEpochs):
                          host_index=host_index, host_count=host_count)
 
     @staticmethod
-    def _cache_dir(path: str) -> str:
-        """Writable cache location for ``path``.
+    def _cache_dir(path: str, hash_buckets: int, num_sparse: int) -> str:
+        """Writable cache location for ``(path, parse config)``.
 
-        Default: ``<file>.dtfcache/`` next to the source. Datasets often live
-        on read-only mounts, so ``DTF_DATA_CACHE`` overrides the root (cache
-        dirs are then keyed by a hash of the absolute source path), and an
-        unwritable default falls back to a per-user tmp root automatically.
+        The parse config is part of the directory name, so jobs with
+        different ``hash_buckets``/``num_sparse`` build in DISJOINT dirs —
+        concurrent mixed-config builders can never tear each other's cache
+        (same-config builders produce identical bytes; see _build_cache).
+
+        Default root: next to the source. Datasets often live on read-only
+        mounts, so ``DTF_DATA_CACHE`` overrides the root (cache dirs are
+        then keyed by a hash of the absolute source path), and an unwritable
+        default falls back to a per-user tmp root automatically.
         """
+        tag = f"dtfcache-hb{hash_buckets}-ns{num_sparse}"
         root = os.environ.get("DTF_DATA_CACHE")
         if not root:
-            d = path + ".dtfcache"
+            d = f"{path}.{tag}"
             try:
                 os.makedirs(d, exist_ok=True)
                 probe = os.path.join(d, f".w.{os.getpid()}")
@@ -290,7 +296,7 @@ class CriteoCsvData(ShardedEpochs):
                 root = os.path.join(tempfile.gettempdir(),
                                     f"dtf_data_cache_{os.getuid()}")
         key = zlib.crc32(os.path.abspath(path).encode())
-        d = os.path.join(root, f"{os.path.basename(path)}.{key:08x}.dtfcache")
+        d = os.path.join(root, f"{os.path.basename(path)}.{key:08x}.{tag}")
         os.makedirs(d, exist_ok=True)
         return d
 

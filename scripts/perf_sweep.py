@@ -41,8 +41,10 @@ def child():
     from dtf_tpu.models import resnet
 
     batch = int(os.environ["DTF_PERF_BATCH"])
-    mode = os.environ.get("DTF_PERF_MODE", "dispatch")  # dispatch | scan
+    # dispatch | scan | profile
+    mode = os.environ.get("DTF_PERF_MODE", "dispatch")
     n_steps = int(os.environ.get("DTF_PERF_STEPS", "20"))
+    bf16_input = os.environ.get("DTF_PERF_BF16_IN") == "1"
 
     mesh = make_mesh()
     model = resnet.resnet50()
@@ -53,13 +55,15 @@ def child():
     step = tr.make_train_step(resnet.make_loss(model), tx, mesh, shardings,
                               log_grad_norm=False)
 
+    import jax.numpy as jnp
     rng = np.random.default_rng(0)
+    img = rng.random((batch, 224, 224, 3), np.float32)
     data = shard_batch(
-        {"image": rng.random((batch, 224, 224, 3), np.float32),
+        {"image": jnp.asarray(img, jnp.bfloat16) if bf16_input else img,
          "label": rng.integers(0, 1000, (batch,)).astype(np.int32)}, mesh)
 
     row = {"batch": batch, "mode": mode, "n_steps": n_steps,
-           "backend": jax.default_backend()}
+           "bf16_input": bf16_input, "backend": jax.default_backend()}
 
     # XLA's own cost model for one compiled step (only once, on the 128 run).
     if os.environ.get("DTF_PERF_COST") == "1":
@@ -87,12 +91,44 @@ def child():
                 return s2, m["loss"]
             return jax.lax.scan(body, state, None, length=n_steps)
 
+        # fence with a VALUE READBACK: on the axon plugin block_until_ready
+        # returns early (the r2 sweep measured an impossible 0.2 ms/step
+        # this way); float() forces the transfer and cannot lie.
         state2, losses = k_steps(state, data)
-        jax.block_until_ready(losses)
+        float(losses[-1])
         t0 = time.perf_counter()
         state2, losses = k_steps(state, data)
-        jax.block_until_ready(losses)
+        float(losses[-1])
         dt = time.perf_counter() - t0
+    elif mode == "profile":
+        import glob
+        import gzip
+        prof_dir = os.path.join(ROOT, "profile_r03")
+        for _ in range(3):
+            state, metrics = step(state, data)
+        float(metrics["loss"])
+        with jax.profiler.trace(prof_dir):
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                state, metrics = step(state, data)
+            float(metrics["loss"])
+            dt = time.perf_counter() - t0
+        # parse the XPlane with the tensorboard profile plugin → top ops
+        try:
+            from tensorboard_plugin_profile.convert import raw_to_tool_data
+            xplanes = glob.glob(os.path.join(
+                prof_dir, "plugins/profile/*/*.xplane.pb"))
+            data_str, _ = raw_to_tool_data.xspace_to_tool_data(
+                [xplanes[-1]], "framework_op_stats", {"tqx": "out:csv;"})
+            if isinstance(data_str, bytes):
+                data_str = data_str.decode()
+            if data_str.startswith("\x1f\x8b".encode().decode("latin1")):
+                data_str = gzip.decompress(
+                    data_str.encode("latin1")).decode()
+            row["op_stats_csv_head"] = "\n".join(
+                data_str.splitlines()[:25])
+        except Exception as e:
+            row["profile_parse_error"] = repr(e)[:500]
     else:
         for _ in range(3):
             state, metrics = step(state, data)
@@ -117,13 +153,32 @@ def child():
 def main():
     from _dtf_watchdog import child_argv, run_watchdogged
 
-    grid = []
+    default_grid = []
     for batch in (128, 256, 512, 1024):
-        grid.append({"DTF_PERF_BATCH": str(batch), "DTF_PERF_MODE": "dispatch",
-                     "DTF_PERF_COST": "1" if batch == 128 else "0"})
-    grid.append({"DTF_PERF_BATCH": "256", "DTF_PERF_MODE": "scan"})
-    grid.append({"DTF_PERF_BATCH": "1024", "DTF_PERF_MODE": "scan"})
+        default_grid.append(
+            {"DTF_PERF_BATCH": str(batch), "DTF_PERF_MODE": "dispatch",
+             "DTF_PERF_COST": "1" if batch == 128 else "0"})
+    default_grid.append({"DTF_PERF_BATCH": "256", "DTF_PERF_MODE": "scan"})
+    default_grid.append({"DTF_PERF_BATCH": "1024", "DTF_PERF_MODE": "scan"})
+    grids = {
+        "default": default_grid,
+        # round-2 findings: throughput FALLS with batch → probe smaller
+        # batches, bf16 host input, the fixed scan fence, and a profile.
+        "followup": [
+            {"DTF_PERF_BATCH": "64", "DTF_PERF_MODE": "dispatch"},
+            {"DTF_PERF_BATCH": "96", "DTF_PERF_MODE": "dispatch"},
+            {"DTF_PERF_BATCH": "128", "DTF_PERF_MODE": "dispatch",
+             "DTF_PERF_BF16_IN": "1"},
+            {"DTF_PERF_BATCH": "128", "DTF_PERF_MODE": "scan"},
+            {"DTF_PERF_BATCH": "128", "DTF_PERF_MODE": "profile",
+             "DTF_PERF_STEPS": "5"},
+        ],
+    }
+    grid = grids[sys.argv[1] if len(sys.argv) > 1 else "default"]
 
+    tag = sys.argv[1] if len(sys.argv) > 1 else "default"
+    artifact = (ARTIFACT if tag == "default"
+                else ARTIFACT.replace(".json", f"_{tag}.json"))
     rows, errors = [], []
     for env_extra in grid:
         env = dict(os.environ)
@@ -138,7 +193,7 @@ def main():
         else:
             rows.append(row)
         # write incrementally so partial progress survives a later hang
-        with open(ARTIFACT, "w") as f:
+        with open(artifact, "w") as f:
             json.dump({"rows": rows, "errors": errors}, f, indent=1)
         print(json.dumps(rows[-1] if rows else errors[-1]))
     return 0 if rows else 1

@@ -31,6 +31,11 @@ flags.DEFINE_integer("moe_every", 0, "every k-th block uses Switch-MoE "
 flags.DEFINE_boolean("remat", False, "jax.checkpoint each block")
 flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
                     "zigzag (load-balanced causal ring; needs mesh_seq>1)")
+flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
+                     "mesh_pipe>1 (0 = 4x stages, the bubble-amortizing "
+                     "default)")
+flags.DEFINE_integer("pipe_interleave", 1, "model chunks per pipe device "
+                     "(Megatron interleaved schedule when >1)")
 FLAGS = flags.FLAGS
 
 
@@ -59,17 +64,60 @@ def main(argv):
 
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,
                               remat=FLAGS.remat, attn_impl=FLAGS.attn_impl)
-    # the model needs the mesh for ring attention (seq axis) AND for the
-    # shard_map'd flash kernel (model axis) — pass it unconditionally.
-    model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
     tx = optax.adamw(
         optax.warmup_cosine_decay_schedule(
             0.0, FLAGS.learning_rate,
             min(1000, FLAGS.train_steps // 10 + 1), FLAGS.train_steps),
         weight_decay=0.1)
+    pipelined = mesh.shape.get("pipe", 1) > 1
+    if pipelined:
+        from dtf_tpu.models import gpt_pipe
+
+        if sp:
+            raise app.UsageError(
+                "--mesh_pipe>1 cannot combine with --mesh_seq>1: pipeline "
+                "stages run mesh-less, so seq sharding would silently "
+                "degrade to unsharded attention on permuted data")
+        if mesh.shape.get("model", 1) > 1:
+            absl_logging.warning(
+                "--mesh_model>1 is unused under --mesh_pipe>1 (no TP inside "
+                "pipeline stages); those devices idle")
+        # microbatch rule: n_micro | batch and (batch/n_micro) % data == 0;
+        # the interleaved schedule additionally needs n_micro % pipe == 0.
+        # Default: the largest feasible count <= 4x stages (amortizes the
+        # (S-1)/(M+S-1) bubble without starving the data shards).
+        per_data = FLAGS.batch_size // mesh.shape.get("data", 1)
+        n_micro = FLAGS.pipe_microbatches
+        if not n_micro:
+            pipe_n = mesh.shape["pipe"]
+            cands = [n for n in range(1, 4 * pipe_n + 1)
+                     if per_data % n == 0
+                     and (FLAGS.pipe_interleave == 1 or n % pipe_n == 0)]
+            if not cands:
+                raise app.UsageError(
+                    f"no feasible pipeline microbatch count for batch "
+                    f"{FLAGS.batch_size} / data={mesh.shape.get('data', 1)} "
+                    f"/ pipe={pipe_n} / interleave={FLAGS.pipe_interleave}; "
+                    "adjust --batch_size or set --pipe_microbatches")
+            n_micro = max(cands)
+            absl_logging.info("pipeline: using %d microbatches", n_micro)
+        init_fn = gpt_pipe.make_pipe_init(
+            cfg, mesh, seq_len=FLAGS.seq_len,
+            interleave_v=FLAGS.pipe_interleave)
+        loss_fn = gpt_pipe.make_pipe_loss(
+            cfg, mesh, n_microbatches=n_micro,
+            interleave_v=FLAGS.pipe_interleave)
+        param_rules = gpt_pipe.pipe_rules()
+        model = None
+    else:
+        # the model needs the mesh for ring attention (seq axis) AND for the
+        # shard_map'd flash kernel (model axis) — pass it unconditionally.
+        model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
+        loss_fn = gpt.make_loss(model)
+        param_rules = gpt.tp_rules
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
-        param_rules=gpt.tp_rules, zero1=FLAGS.zero1)
+        param_rules=param_rules, zero1=FLAGS.zero1)
 
     from dtf_tpu.data import formats
 
@@ -91,7 +139,7 @@ def main(argv):
         spec = P("data", "seq")
         kwargs["batch_shardings"] = batch_shardings_for(
             data.batch(0), mesh, spec)
-    step = tr.make_train_step(gpt.make_loss(model), tx, mesh, shardings,
+    step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                               grad_accum=FLAGS.grad_accum, **kwargs)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)

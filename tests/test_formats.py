@@ -242,15 +242,17 @@ def test_criteo_streaming_1m_rows_bounded(tmp_path, monkeypatch):
     data = formats.CriteoCsvData(str(p), 64, hash_buckets=1000)
     build_s = _t.perf_counter() - t0
     assert data.n_rows == n
-    assert build_s < 120, f"1M-row parse took {build_s:.0f}s"
+    # generous bound: ~6s typical; guards O(n^2)-style regressions, not
+    # CI-machine speed.
+    assert build_s < 300, f"1M-row parse took {build_s:.0f}s"
     assert isinstance(data.dense, np.memmap)  # not RAM-resident lists
     # chunk-boundary rows parsed identically to their variant
     b = next(iter(data))
     assert b["dense"].shape == (64, 13) and b["sparse"].shape == (64, 26)
-    # reopen: cache hit must be near-instant
+    # reopen: cache hit must not reparse (mmap open, not a 1M-row build)
     t0 = _t.perf_counter()
     formats.CriteoCsvData(str(p), 64, hash_buckets=1000)
-    assert _t.perf_counter() - t0 < 2.0
+    assert _t.perf_counter() - t0 < build_s / 2 + 1.0
 
 
 # ----------------------------------------------------- detection precedence

@@ -1,0 +1,105 @@
+"""Transformer blocks through the pipeline schedules (VERDICT r2 weak #4).
+
+The oracle is make_sequential_loss: identical math on the SAME stacked
+params, stages applied in logical order without a schedule. Parity of the
+loss SEQUENCE over real optimizer steps proves forward AND backward
+(gradients flow through scan+ppermute) for real attention/LN/residual
+stages — not the tanh-MLP toys of test_pipeline.py.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from dtf_tpu.core import train as tr
+from dtf_tpu.core.comms import shard_batch
+from dtf_tpu.core.mesh import MeshConfig, make_mesh
+from dtf_tpu.models import gpt, gpt_pipe
+
+
+def _tiny(**kw):
+    return gpt.GPTConfig.tiny(attn_impl="dense", dtype=jnp.float32, **kw)
+
+
+def _batches(cfg, n, batch=16, t=16):
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(n):
+        ids = rng.integers(0, cfg.vocab_size, (batch, t + 1))
+        out.append({"input_ids": ids[:, :-1].astype(np.int32),
+                    "labels": ids[:, 1:].astype(np.int32)})
+    return out
+
+
+def _run_steps(loss_fn, init_fn, mesh, rules, batches):
+    tx = optax.sgd(0.1)
+    state, shardings = tr.create_train_state(
+        init_fn, tx, jax.random.PRNGKey(0), mesh, param_rules=rules,
+        zero1=False)
+    step = tr.make_train_step(loss_fn, tx, mesh, shardings,
+                              log_grad_norm=False)
+    losses = []
+    for b in batches:
+        state, m = step(state, shard_batch(b, mesh))
+        losses.append(float(m["loss"]))
+    return losses
+
+
+@pytest.mark.parametrize("pipe,layers", [(2, 4), (4, 4)])
+def test_gpipe_transformer_matches_sequential(pipe, layers):
+    cfg = dataclasses.replace(_tiny(), layers=layers)
+    mesh = make_mesh(MeshConfig(data=8 // pipe, pipe=pipe))
+    batches = _batches(cfg, 3)
+    init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16)
+    got = _run_steps(
+        gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    want = _run_steps(
+        gpt_pipe.make_sequential_loss(cfg, pipe),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_interleaved_transformer_matches_sequential():
+    cfg = dataclasses.replace(_tiny(), layers=4)
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    batches = _batches(cfg, 3)
+    init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16, interleave_v=2)
+    got = _run_steps(
+        gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4, interleave_v=2),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    want = _run_steps(
+        gpt_pipe.make_sequential_loss(cfg, 2, interleave_v=2),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_pipe_cfg_validation():
+    cfg = _tiny()  # 2 layers
+    with pytest.raises(ValueError, match="must divide"):
+        gpt_pipe.validate_pipe_cfg(cfg, n_stages=3)
+    with pytest.raises(ValueError, match="MoE"):
+        gpt_pipe.validate_pipe_cfg(
+            dataclasses.replace(cfg, moe_every=1), n_stages=2)
+    with pytest.raises(ValueError, match="decode"):
+        gpt_pipe.validate_pipe_cfg(
+            dataclasses.replace(cfg, decode_len=8), n_stages=2)
+
+
+def test_pipe_remat_matches_plain():
+    """remat inside a stage must not change the numbers."""
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    batches = _batches(_tiny(), 2)
+    losses = {}
+    for remat in (False, True):
+        cfg = dataclasses.replace(_tiny(), layers=4, remat=remat)
+        init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16)
+        losses[remat] = _run_steps(
+            gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4),
+            init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=1e-5, atol=1e-5)
