@@ -284,15 +284,37 @@ def make_init(cfg: GPTConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
     return model, init_fn
 
 
+def cache_shardings(mesh: Mesh, cache_shapes):
+    """NamedSharding tree for a KV-cache collection: [B, H, L, D] leaves
+    shard batch over ``data`` and heads over ``model`` (the layout
+    ``decode_len`` exists for — each TP shard serves its own heads, each DP
+    shard its own sequences); scalar indices replicate."""
+    from jax.sharding import NamedSharding
+
+    def leaf(s):
+        if getattr(s, "ndim", 0) == 4:
+            return NamedSharding(mesh, P("data", "model", None, None))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(leaf, cache_shapes)
+
+
 def generate(model: GPT, params, prompt: jax.Array, n_new: int,
              *, rng: Optional[jax.Array] = None,
-             temperature: float = 0.0) -> jax.Array:
+             temperature: float = 0.0,
+             mesh: Optional[Mesh] = None) -> jax.Array:
     """Autoregressive decode with the KV cache, as one ``lax.scan``.
 
     ``model.cfg.decode_len`` must cover prompt+new tokens. ``prompt``
     [B, T_p] int32; returns [B, T_p + n_new]. Greedy when temperature==0,
     else temperature sampling. The whole loop is jittable: the cache is
     scan-carried state, one token per step — the standard TPU decode shape.
+
+    ``mesh``: shard the decode — the KV cache lands P('data','model')
+    (batch over data shards, heads over TP shards; see
+    :func:`cache_shardings`), the prompt P('data'). Params keep whatever
+    sharding the caller placed them with (e.g. :data:`tp_rules`); GSPMD
+    propagates through the scan, so TP decode needs no other change.
     """
     cfg = model.cfg
     b, t_p = prompt.shape
@@ -302,6 +324,13 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
             f"decode_len={cfg.decode_len} < prompt+new={total}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if mesh is not None:
+        if b % mesh.shape.get("data", 1):
+            raise ValueError(f"decode batch {b} not divisible by the data "
+                             f"axis ({mesh.shape.get('data', 1)})")
+        if cfg.heads % mesh.shape.get("model", 1):
+            raise ValueError(f"{cfg.heads} heads not divisible by the model "
+                             f"axis ({mesh.shape.get('model', 1)})")
 
     # Build an all-zeros cache (index 0, no slots written) without
     # materialising a throwaway parameter set: eval_shape traces init
@@ -309,8 +338,16 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     shapes = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0),
                            jnp.zeros((b, 1), jnp.int32)))
-    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
-                          shapes["cache"])
+    if mesh is not None:
+        csh = cache_shardings(mesh, shapes["cache"])
+        cache0 = jax.tree.map(
+            lambda s, sh: jax.device_put(jnp.zeros(s.shape, s.dtype), sh),
+            shapes["cache"], csh)
+        prompt = jax.device_put(
+            prompt, jax.sharding.NamedSharding(mesh, P("data", None)))
+    else:
+        cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                              shapes["cache"])
 
     def body(carry, t):
         cache, tok, rng = carry
