@@ -340,8 +340,11 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
                            jnp.zeros((b, 1), jnp.int32)))
     if mesh is not None:
         csh = cache_shardings(mesh, shapes["cache"])
+        # sharding-aware allocation: each device materializes only its
+        # shard — the global-zeros-then-reshard form would OOM device 0 for
+        # exactly the cache sizes this path exists for.
         cache0 = jax.tree.map(
-            lambda s, sh: jax.device_put(jnp.zeros(s.shape, s.dtype), sh),
+            lambda s, sh: jnp.zeros(s.shape, s.dtype, device=sh),
             shapes["cache"], csh)
         prompt = jax.device_put(
             prompt, jax.sharding.NamedSharding(mesh, P("data", None)))

@@ -1,0 +1,259 @@
+"""Megatron tensor parallelism INSIDE pipeline stages (TP x PP x DP).
+
+`gpt_pipe` runs blocks mesh-less inside the pipeline's ``shard_map``, so
+``--mesh_model`` idled under ``--mesh_pipe``. This module composes them the
+Megatron way: the pipeline body is manual over ('pipe','data','model'), and
+each transformer block is written with explicit column-/row-parallel
+matmuls — qkv and mlp-in column-sharded (no communication, each shard owns
+``heads/tp`` heads), attn-out and mlp-out row-sharded with ONE
+``lax.psum`` over ``model`` per residual branch (the Megatron f/g
+operators), row biases added once after the psum.
+
+The stage is PURE FUNCTIONS over a param pytree, not flax modules: flax
+re-validates declared param shapes at apply time, which can never hold when
+params arrive as shard-local slices inside ``shard_map`` (global [d, d] at
+init, local [d, d/tp] at apply). Plain functions use runtime shapes —
+head counts derive from the local qkv width — so the SAME code serves the
+sharded pipeline body (``tp_axis='model'``) and the unsharded sequential
+parity oracle (``tp_axis=None``); init always runs global, outside the
+mesh, with no collectives traced.
+
+Reference: TP and PP are both beyond the reference's scope (SURVEY.md §2c);
+this is the composition a real TPU framework needs for models that exceed
+one chip under either axis alone.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.core.sharding import path_str
+from dtf_tpu.core.train import LossAux
+from dtf_tpu.models.gpt import GPTConfig, rope
+from dtf_tpu.models.gpt_pipe import GPTEmbed, GPTHead, validate_pipe_cfg
+from dtf_tpu.ops import attention as att
+from dtf_tpu.ops.losses import softmax_cross_entropy
+from dtf_tpu.parallel import pipeline as pp
+
+PyTree = Any
+
+
+# ------------------------------------------------------------------ params
+
+def _init_dense(rng, d_in: int, d_out: int) -> PyTree:
+    return {"kernel": nn.initializers.lecun_normal()(rng, (d_in, d_out),
+                                                     jnp.float32),
+            "bias": jnp.zeros((d_out,), jnp.float32)}
+
+
+def _init_ln(d: int) -> PyTree:
+    return {"scale": jnp.ones((d,), jnp.float32),
+            "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def init_block(rng: jax.Array, cfg: GPTConfig) -> PyTree:
+    ks = jax.random.split(rng, 6)
+    d, dff = cfg.d_model, cfg.d_ff
+    return {
+        "ln1": _init_ln(d),
+        "query": _init_dense(ks[0], d, d),
+        "key": _init_dense(ks[1], d, d),
+        "value": _init_dense(ks[2], d, d),
+        "attn_out": _init_dense(ks[3], d, d),
+        "ln2": _init_ln(d),
+        "mlp_in": _init_dense(ks[4], d, dff),
+        "mlp_out": _init_dense(ks[5], dff, d),
+    }
+
+
+def init_stage(rng: jax.Array, cfg: GPTConfig, n_layers: int) -> PyTree:
+    return {f"block_{i}": init_block(k, cfg)
+            for i, k in enumerate(jax.random.split(rng, n_layers))}
+
+
+# ------------------------------------------------------------------- apply
+
+def _layernorm(x, p):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = ((xf - mu) ** 2).mean(-1, keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (y * p["scale"] + p["bias"]).astype(x.dtype)
+
+
+def _col(p, x, dtype):
+    """Column-parallel matmul: local kernel [d_in, d_out/tp]; bias is the
+    matching local slice; output is this shard's columns. No comm."""
+    return x @ p["kernel"].astype(dtype) + p["bias"].astype(dtype)
+
+
+def _row(p, x, dtype, tp_axis):
+    """Row-parallel matmul: local kernel [d_in/tp, d_out] makes a partial
+    product; ONE psum reduces over tp; the replicated bias is added once,
+    after the reduction (Megatron's g operator)."""
+    y = x @ p["kernel"].astype(dtype)
+    if tp_axis is not None:
+        y = jax.lax.psum(y, tp_axis)
+    return y + p["bias"].astype(dtype)
+
+
+def apply_block(cfg: GPTConfig, tp_axis: Optional[str], p: PyTree,
+                x: jax.Array) -> jax.Array:
+    d_head = cfg.d_model // cfg.heads
+    b, t, _ = x.shape
+    dtype = cfg.dtype
+    x = x.astype(dtype)
+
+    h = _layernorm(x, p["ln1"])
+
+    def split(v):  # [B,T,local_width] -> [B,local_heads,T,d_head]
+        return v.reshape(b, t, -1, d_head).transpose(0, 2, 1, 3)
+
+    q = split(_col(p["query"], h, dtype))
+    k = split(_col(p["key"], h, dtype))
+    v = split(_col(p["value"], h, dtype))
+    positions = jnp.arange(t)
+    q = rope(q, positions, cfg.rope_theta)
+    k = rope(k, positions, cfg.rope_theta)
+    out = att.dense_attention(q, k, v, causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(b, t, -1)
+    x = x + _row(p["attn_out"], out, dtype, tp_axis)
+
+    h = _layernorm(x, p["ln2"])
+    y = nn.gelu(_col(p["mlp_in"], h, dtype), approximate=True)
+    y = _row(p["mlp_out"], y, dtype, tp_axis)
+    return x + y
+
+
+def apply_stage(cfg: GPTConfig, tp_axis: Optional[str], n_layers: int,
+                p: PyTree, x: jax.Array) -> jax.Array:
+    fn = apply_block
+    if cfg.remat:
+        fn = jax.checkpoint(apply_block, static_argnums=(0, 1))
+    for i in range(n_layers):
+        x = fn(cfg, tp_axis, p[f"block_{i}"], x)
+    return x
+
+
+# ---------------------------------------------------------------- sharding
+
+def _stage_spec_for(path: str, pipe_axis: str, tp_axis: str) -> P:
+    """Per-leaf PartitionSpec for a STACKED stage tree (leading row dim)."""
+    if re.search(r"(query|key|value|mlp_in)/kernel", path):
+        return P(pipe_axis, None, tp_axis)       # column parallel
+    if re.search(r"(query|key|value|mlp_in)/bias", path):
+        return P(pipe_axis, tp_axis)
+    if re.search(r"(attn_out|mlp_out)/kernel", path):
+        return P(pipe_axis, tp_axis, None)       # row parallel
+    return P(pipe_axis)                          # LN params, row biases
+
+
+def stage_specs(stacked_params: PyTree, *, pipe_axis: str = "pipe",
+                tp_axis: str = "model") -> PyTree:
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: _stage_spec_for(path_str(p), pipe_axis, tp_axis),
+        stacked_params)
+
+
+def pipe_tp_rules(pipe_axis: str = "pipe", tp_axis: str = "model"):
+    """create_train_state param_rules for the full {embed,stages,head} tree."""
+    return [
+        (r"stages/.*(query|key|value|mlp_in)/kernel",
+         P(pipe_axis, None, tp_axis)),
+        (r"stages/.*(query|key|value|mlp_in)/bias", P(pipe_axis, tp_axis)),
+        (r"stages/.*(attn_out|mlp_out)/kernel", P(pipe_axis, tp_axis, None)),
+        (r"^stages/", P(pipe_axis)),
+    ]
+
+
+# --------------------------------------------------------------- factories
+
+def _check(cfg: GPTConfig, mesh: Mesh, axis_name: str, tp_axis: str) -> int:
+    n_stages = mesh.shape.get(axis_name, 1)
+    per_row = validate_pipe_cfg(cfg, n_stages, 1)
+    tp = mesh.shape.get(tp_axis, 1)
+    if cfg.heads % tp:
+        raise ValueError(f"{cfg.heads} heads not divisible by {tp_axis}={tp}")
+    if cfg.d_ff % tp or cfg.d_model % tp:
+        raise ValueError(
+            f"d_model={cfg.d_model}/d_ff={cfg.d_ff} not divisible by "
+            f"{tp_axis}={tp}")
+    if cfg.attn_impl not in ("dense", "auto"):
+        raise ValueError(
+            f"TP-in-pipe blocks use per-shard dense attention; "
+            f"attn_impl={cfg.attn_impl!r} is not supported here")
+    return per_row
+
+
+def make_pipe_tp_init(cfg: GPTConfig, mesh: Mesh, *, seq_len: int = 128,
+                      axis_name: str = "pipe", tp_axis: str = "model"):
+    per_row = _check(cfg, mesh, axis_name, tp_axis)
+    n_stages = mesh.shape.get(axis_name, 1)
+    b = mesh.shape.get("data", 1)
+
+    def init_fn(rng):
+        r_e, r_s, r_h = jax.random.split(rng, 3)
+        ids = jnp.zeros((b, seq_len), jnp.int32)
+        x = jnp.zeros((1, seq_len, cfg.d_model), cfg.dtype)
+        return {"params": {
+            "embed": GPTEmbed(cfg).init(r_e, ids)["params"],
+            "stages": pp.init_stacked(
+                lambda r: init_stage(r, cfg, per_row), n_stages, r_s),
+            "head": GPTHead(cfg).init(r_h, x)["params"],
+        }}
+
+    return init_fn
+
+
+def make_pipe_tp_loss(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
+                      axis_name: str = "pipe", tp_axis: str = "model"):
+    """Loss fn: GPipe schedule over ``pipe`` with Megatron TP over
+    ``tp_axis`` inside every stage."""
+    per_row = _check(cfg, mesh, axis_name, tp_axis)
+
+    def stage_fn(stage_params, x):
+        return apply_stage(cfg, tp_axis, per_row, stage_params, x)
+
+    pipe = pp.pipeline_spmd(
+        stage_fn, n_microbatches, mesh, axis_name=axis_name,
+        param_specs_fn=lambda params: stage_specs(
+            params, pipe_axis=axis_name, tp_axis=tp_axis),
+        check_vma=False)
+
+    def loss_fn(params, extra, batch, rng):
+        del rng
+        x = GPTEmbed(cfg).apply({"params": params["embed"]},
+                                batch["input_ids"])
+        x = pipe(params["stages"], x)
+        logits = GPTHead(cfg).apply({"params": params["head"]}, x)
+        loss, n = softmax_cross_entropy(logits, batch["labels"],
+                                        ignore_index=-100)
+        return loss, LossAux(extra=extra, metrics={"lm_tokens": n}, weight=n)
+
+    return loss_fn
+
+
+def make_sequential_tp_loss(cfg: GPTConfig, n_stages: int):
+    """Parity oracle: the same block functions with ``tp_axis=None`` on the
+    full params, stages applied in order — identical math, no mesh."""
+    per_row = validate_pipe_cfg(cfg, n_stages, 1)
+
+    def loss_fn(params, extra, batch, rng):
+        del rng
+        x = GPTEmbed(cfg).apply({"params": params["embed"]},
+                                batch["input_ids"])
+        for s in range(n_stages):
+            row = jax.tree.map(lambda t: t[s], params["stages"])
+            x = apply_stage(cfg, None, per_row, row, x)
+        logits = GPTHead(cfg).apply({"params": params["head"]}, x)
+        loss, n = softmax_cross_entropy(logits, batch["labels"],
+                                        ignore_index=-100)
+        return loss, LossAux(extra=extra, metrics={"lm_tokens": n}, weight=n)
+
+    return loss_fn

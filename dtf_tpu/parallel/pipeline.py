@@ -60,6 +60,8 @@ def pipeline_spmd(
     axis_name: str = AXIS_PIPE,
     batch_spec: P = P("data"),
     param_spec_fn: Callable[[Any], P] | None = None,
+    param_specs_fn: Callable[[PyTree], PyTree] | None = None,
+    check_vma: bool = True,
 ):
     """Build ``f(stacked_params, x) -> y`` running stages over ``axis_name``.
 
@@ -73,6 +75,13 @@ def pipeline_spmd(
 
     Returns a function usable under ``jit``; gradients flow through to the
     stacked params and the input.
+
+    ``param_specs_fn``: full params→spec-TREE mapping (path-dependent specs,
+    e.g. Megatron TP dims inside stages — see
+    :mod:`dtf_tpu.models.gpt_pipe_tp`); overrides the leaf-wise
+    ``param_spec_fn``. ``check_vma=False`` disables shard_map's
+    varying-manual-axes typing for bodies that mix axes it cannot type
+    (per-shard collectives inside the stage).
     """
     n_stages = mesh.shape.get(axis_name, 1)
 
@@ -101,8 +110,9 @@ def pipeline_spmd(
             # pvary: xs arrives replicated over pipe but mixes with
             # pipe-varying values (stage outputs) below — shard_map's
             # varying-manual-axes type system requires the promotion to be
-            # explicit.
-            xs = jax.lax.pcast(xs, (axis_name,), to="varying")
+            # explicit. (Skipped when the caller disabled vma typing.)
+            if check_vma:
+                xs = jax.lax.pcast(xs, (axis_name,), to="varying")
             p = jax.tree.map(lambda t: t[0], params)
             idx = jax.lax.axis_index(axis_name)
             shift = [(i, i + 1) for i in range(n_stages - 1)]
@@ -133,13 +143,17 @@ def pipeline_spmd(
             # replicate over the pipe axis with one psum.
             return jax.lax.psum(out, axis_name)
 
-        p_spec = (jax.tree.map(param_spec_fn, params)
-                  if param_spec_fn is not None
-                  else stage_param_specs(params, axis_name))
+        if param_specs_fn is not None:
+            p_spec = param_specs_fn(params)
+        elif param_spec_fn is not None:
+            p_spec = jax.tree.map(param_spec_fn, params)
+        else:
+            p_spec = stage_param_specs(params, axis_name)
         micro_spec = P(None, *batch_spec)
         y = jax.shard_map(
             body, mesh=mesh,
             in_specs=(p_spec, micro_spec), out_specs=micro_spec,
+            check_vma=check_vma,
         )(params, micro)
         return y.reshape(x.shape[0:1] + y.shape[2:])
 

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Single-chip benchmarks for BASELINE configs 4 and 5 (VERDICT r2 #6).
+
+- **BERT-base MLM** (config 4): seq 512, gradient accumulation + ZeRO-1 —
+  the exact machinery the config row names — measured as tokens/sec with
+  MFU from BOTH the analytic 6N·tokens rule and XLA's own cost analysis.
+- **Wide&Deep** (config 5): Criteo-shaped batch through the row-sharded
+  embedding path, measured as examples/sec.
+
+Same resilience contract as bench.py: parent never imports jax, children
+run under the watchdog, artifact ``BENCH_LM.json`` always gets written.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+ARTIFACT = os.path.join(ROOT, "BENCH_LM.json")
+SENTINEL = "BENCH_LM_ROW "
+CHILD_TIMEOUT_S = 900
+V5E_PEAK_BF16_FLOPS = 197e12
+
+
+def _count_params(tree):
+    import jax
+
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def child():
+    sys.path.insert(0, ROOT)
+    import jax
+    import numpy as np
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.comms import shard_batch
+    from dtf_tpu.core.mesh import make_mesh
+    which = os.environ["DTF_LM_WHICH"]
+    mesh = make_mesh()
+    row = {"model": which, "backend": jax.default_backend(),
+           "n_chips": mesh.devices.size}
+
+    if which == "bert":
+        from dtf_tpu.data.synthetic import SyntheticData
+        from dtf_tpu.models import bert
+
+        tiny = os.environ.get("DTF_LM_TINY") == "1"  # CPU-sim logic check
+        batch = int(os.environ.get("DTF_LM_BATCH", "8" if tiny else "32"))
+        seq = int(os.environ.get("DTF_LM_SEQ", "64" if tiny else "512"))
+        accum = int(os.environ.get("DTF_LM_ACCUM", "2" if tiny else "4"))
+        cfg = bert.BertConfig.tiny() if tiny else bert.BertConfig.base()
+        model, init_fn = bert.make_init(cfg, None, seq_len=seq)
+        tx = optax.adamw(1e-4, weight_decay=0.01)
+        # config 4's machinery: ZeRO-1 + grad accum
+        state, shardings = tr.create_train_state(
+            init_fn, tx, jax.random.PRNGKey(0), mesh,
+            param_rules=bert.tp_rules, zero1=True)
+        step = tr.make_train_step(bert.make_loss(model), tx, mesh, shardings,
+                                  grad_accum=accum, log_grad_norm=False)
+        data = shard_batch(
+            SyntheticData("bert", batch, seed=0, seq_len=seq,
+                          vocab_size=cfg.vocab_size).batch(0), mesh)
+        n_params = _count_params(state.params)
+        row.update(batch=batch, seq=seq, grad_accum=accum,
+                   n_params=int(n_params), zero1=True)
+        unit_scale = batch * seq  # tokens per step
+    else:
+        from dtf_tpu.models import widedeep
+
+        batch = int(os.environ.get("DTF_LM_BATCH", "8192"))
+        model = widedeep.WideDeep(hash_buckets=100000)
+        tx = optax.adagrad(0.01)
+        state, shardings = tr.create_train_state(
+            widedeep.make_init(model), tx, jax.random.PRNGKey(0), mesh,
+            param_rules=widedeep.rules)
+        step = tr.make_train_step(widedeep.make_loss(model), tx, mesh,
+                                  shardings, log_grad_norm=False)
+        rng = np.random.default_rng(0)
+        data = shard_batch(
+            {"dense": rng.random((batch, 13), np.float32),
+             "sparse": rng.integers(0, 100000, (batch, 26)).astype(np.int32),
+             "label": rng.integers(0, 2, (batch,)).astype(np.float32)}, mesh)
+        row.update(batch=batch, hash_buckets=100000,
+                   n_params=int(_count_params(state.params)))
+        unit_scale = batch  # examples per step
+
+    # XLA's own per-step cost
+    try:
+        cost = step.lower(state, data).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        row["xla_flops_per_step"] = float(cost.get("flops", 0.0))
+        row["xla_bytes_accessed"] = float(cost.get("bytes accessed", 0.0))
+    except Exception as e:
+        row["cost_error"] = repr(e)[:300]
+
+    for _ in range(3):
+        state, metrics = step(state, data)
+    float(metrics["loss"])
+    n_steps = int(os.environ.get("DTF_LM_STEPS", "10"))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, metrics = step(state, data)
+    float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    per_sec = unit_scale * n_steps / dt
+    row["sec_per_step"] = round(dt / n_steps, 5)
+    if which == "bert":
+        row["tokens_per_sec"] = round(per_sec, 1)
+        # analytic: 6 FLOPs per param per token (fwd+bwd, weight FLOPs) +
+        # attention 12*L*h*s per token
+        att = 12 * cfg.layers * cfg.hidden * row["seq"]
+        flops_tok = 6 * row["n_params"] + att
+        row["mfu_analytic"] = round(
+            per_sec * flops_tok / V5E_PEAK_BF16_FLOPS, 4)
+    else:
+        row["examples_per_sec"] = round(per_sec, 1)
+    if "xla_flops_per_step" in row:
+        row["mfu_xla"] = round(
+            row["xla_flops_per_step"] * n_steps / dt / V5E_PEAK_BF16_FLOPS, 4)
+    print(SENTINEL + json.dumps(row))
+
+
+def main():
+    from _dtf_watchdog import child_argv, run_watchdogged
+
+    jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"}]
+    rows, errors = [], []
+    for env_extra in jobs:
+        env = dict(os.environ)
+        env.update(env_extra)
+        row, errs = run_watchdogged(
+            child_argv(os.path.abspath(__file__)),
+            lambda line: (json.loads(line[len(SENTINEL):])
+                          if line.startswith(SENTINEL) else None),
+            timeout_s=CHILD_TIMEOUT_S, retries=3, backoff_s=15, env=env)
+        (rows.append(row) if row is not None
+         else errors.append({"env": env_extra, "errors": errs}))
+        with open(ARTIFACT, "w") as f:
+            json.dump({"rows": rows, "errors": errors}, f, indent=1)
+        print(json.dumps(rows[-1] if row is not None else errors[-1]))
+    return 0 if rows and not errors else 1
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        sys.exit(main())

@@ -78,10 +78,7 @@ def main(argv):
                 "--mesh_pipe>1 cannot combine with --mesh_seq>1: pipeline "
                 "stages run mesh-less, so seq sharding would silently "
                 "degrade to unsharded attention on permuted data")
-        if mesh.shape.get("model", 1) > 1:
-            absl_logging.warning(
-                "--mesh_model>1 is unused under --mesh_pipe>1 (no TP inside "
-                "pipeline stages); those devices idle")
+        tp_in_pipe = mesh.shape.get("model", 1) > 1
         # microbatch rule: n_micro | batch and (batch/n_micro) % data == 0;
         # the interleaved schedule additionally needs n_micro % pipe == 0.
         # Default: the largest feasible count <= 4x stages (amortizes the
@@ -101,13 +98,26 @@ def main(argv):
                     "adjust --batch_size or set --pipe_microbatches")
             n_micro = max(cands)
             absl_logging.info("pipeline: using %d microbatches", n_micro)
-        init_fn = gpt_pipe.make_pipe_init(
-            cfg, mesh, seq_len=FLAGS.seq_len,
-            interleave_v=FLAGS.pipe_interleave)
-        loss_fn = gpt_pipe.make_pipe_loss(
-            cfg, mesh, n_microbatches=n_micro,
-            interleave_v=FLAGS.pipe_interleave)
-        param_rules = gpt_pipe.pipe_rules()
+        if tp_in_pipe:
+            from dtf_tpu.models import gpt_pipe_tp
+
+            if FLAGS.pipe_interleave != 1:
+                raise app.UsageError(
+                    "--pipe_interleave>1 is not supported with TP-in-pipe "
+                    "(--mesh_model>1); use one or the other")
+            init_fn = gpt_pipe_tp.make_pipe_tp_init(
+                cfg, mesh, seq_len=FLAGS.seq_len)
+            loss_fn = gpt_pipe_tp.make_pipe_tp_loss(
+                cfg, mesh, n_microbatches=n_micro)
+            param_rules = gpt_pipe_tp.pipe_tp_rules()
+        else:
+            init_fn = gpt_pipe.make_pipe_init(
+                cfg, mesh, seq_len=FLAGS.seq_len,
+                interleave_v=FLAGS.pipe_interleave)
+            loss_fn = gpt_pipe.make_pipe_loss(
+                cfg, mesh, n_microbatches=n_micro,
+                interleave_v=FLAGS.pipe_interleave)
+            param_rules = gpt_pipe.pipe_rules()
         model = None
     else:
         # the model needs the mesh for ring attention (seq axis) AND for the

@@ -233,7 +233,6 @@ def test_generate_sharded_matches_single_device():
         lambda: model.init(jax.random.PRNGKey(0),
                            jnp.zeros((4, 1), jnp.int32)))
     csh = gpt.cache_shardings(mesh, shapes["cache"])
-    from jax.sharding import PartitionSpec as P
     specs = {s.spec for s in jax.tree.leaves(csh)}
     assert P("data", "model", None, None) in specs
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
