@@ -128,6 +128,9 @@ def cpu_main():
 # --------------------------------------------------------------- real TPU
 
 def tpu_child():
+    """ONE sequence length per child (DTF_ATTN_SEQ): the full 4-seq matrix
+    is ~16 slow axon compiles and blew the 900 s watchdog three times in a
+    row; per-seq children keep each attempt at 4 compiles."""
     import jax
     import jax.numpy as jnp
 
@@ -135,9 +138,7 @@ def tpu_child():
     from dtf_tpu.ops import flash_attention as fa
 
     b, h, d = 2, 8, 128
-    results = {"backend": jax.default_backend(),
-               "device": str(jax.devices()[0]), "dtype": "bfloat16",
-               "b": b, "h": h, "d": d, "rows": []}
+    t = int(os.environ["DTF_ATTN_SEQ"])
 
     def fence_timed(fn, *args, reps=5):
         # scalar-readback fence: float() cannot return before the compute.
@@ -149,59 +150,65 @@ def tpu_child():
             ts.append(time.perf_counter() - t0)
         return statistics.median(ts)
 
-    for t in (1024, 2048, 4096, 8192):
-        ks = jax.random.split(jax.random.PRNGKey(0), 3)
-        q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
-                   for kk in ks)
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
+               for kk in ks)
 
-        def fwd(impl):
-            def f(q, k, v):
-                o = impl(q, k, v)
-                return o.astype(jnp.float32).sum()
-            return jax.jit(f)
+    def fwd(impl):
+        def f(q, k, v):
+            o = impl(q, k, v)
+            return o.astype(jnp.float32).sum()
+        return jax.jit(f)
 
-        def fwdbwd(impl):
-            def loss(q, k, v):
-                return impl(q, k, v).astype(jnp.float32).sum()
+    def fwdbwd(impl):
+        def loss(q, k, v):
+            return impl(q, k, v).astype(jnp.float32).sum()
 
-            def f(q, k, v):
-                dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-                return (dq.astype(jnp.float32).sum()
-                        + dk.astype(jnp.float32).sum()
-                        + dv.astype(jnp.float32).sum())
-            return jax.jit(f)
+        def f(q, k, v):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return (dq.astype(jnp.float32).sum()
+                    + dk.astype(jnp.float32).sum()
+                    + dv.astype(jnp.float32).sum())
+        return jax.jit(f)
 
-        flash = lambda q, k, v: fa.flash_attention(  # noqa: E731
-            q, k, v, causal=True, interpret=False)
-        dense = lambda q, k, v: att.dense_attention(  # noqa: E731
-            q, k, v, causal=True)
+    flash = lambda q, k, v: fa.flash_attention(  # noqa: E731
+        q, k, v, causal=True, interpret=False)
+    dense = lambda q, k, v: att.dense_attention(  # noqa: E731
+        q, k, v, causal=True)
 
-        row = {"seq": t}
-        row["flash_fwd_s"] = round(fence_timed(fwd(flash), q, k, v), 5)
-        row["dense_fwd_s"] = round(fence_timed(fwd(dense), q, k, v), 5)
-        row["flash_fwdbwd_s"] = round(fence_timed(fwdbwd(flash), q, k, v), 5)
-        row["dense_fwdbwd_s"] = round(fence_timed(fwdbwd(dense), q, k, v), 5)
-        row["fwd_speedup"] = round(row["dense_fwd_s"] / row["flash_fwd_s"], 3)
-        row["fwdbwd_speedup"] = round(
-            row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
-        results["rows"].append(row)
-    print(SENTINEL + json.dumps(results))
+    row = {"seq": t, "backend": jax.default_backend(), "b": b, "h": h,
+           "d": d, "dtype": "bfloat16"}
+    row["flash_fwd_s"] = round(fence_timed(fwd(flash), q, k, v), 5)
+    row["dense_fwd_s"] = round(fence_timed(fwd(dense), q, k, v), 5)
+    row["flash_fwdbwd_s"] = round(fence_timed(fwdbwd(flash), q, k, v), 5)
+    row["dense_fwdbwd_s"] = round(fence_timed(fwdbwd(dense), q, k, v), 5)
+    row["fwd_speedup"] = round(row["dense_fwd_s"] / row["flash_fwd_s"], 3)
+    row["fwdbwd_speedup"] = round(
+        row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
+    print(SENTINEL + json.dumps(row))
 
 
 def tpu_main():
     from _dtf_watchdog import run_watchdogged
 
-    result, errors = run_watchdogged(
-        [sys.executable, os.path.abspath(__file__), "tpu", "--child"],
-        lambda line: (json.loads(line[len(SENTINEL):])
-                      if line.startswith(SENTINEL) else None),
-        timeout_s=TPU_CHILD_TIMEOUT_S, retries=3, backoff_s=15,
-        env=dict(os.environ))
-    if result is None:
-        result = {"ok": False, "error": "; ".join(errors)[:3000]}
-    _merge_artifact("tpu", result)
-    print(json.dumps(result))
-    return 0 if result.get("rows") else 1
+    rows, errs_all = [], []
+    for t in (1024, 2048, 4096, 8192):
+        env = dict(os.environ)
+        env["DTF_ATTN_SEQ"] = str(t)
+        row, errors = run_watchdogged(
+            [sys.executable, os.path.abspath(__file__), "tpu", "--child"],
+            lambda line: (json.loads(line[len(SENTINEL):])
+                          if line.startswith(SENTINEL) else None),
+            timeout_s=TPU_CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
+        if row is None:
+            errs_all.append({"seq": t, "errors": errors})
+        else:
+            rows.append(row)
+        # incremental write: partial progress survives a later hang
+        result = {"backend": "tpu", "rows": rows, "errors": errs_all}
+        _merge_artifact("tpu", result)
+        print(json.dumps(row if row is not None else errs_all[-1]))
+    return 0 if rows else 1
 
 
 if __name__ == "__main__":

@@ -6,6 +6,9 @@
   MFU from BOTH the analytic 6N·tokens rule and XLA's own cost analysis.
 - **Wide&Deep** (config 5): Criteo-shaped batch through the row-sharded
   embedding path, measured as examples/sec.
+- **GPT-2 small** (the flagship, beyond the BASELINE list): seq 1024 causal
+  LM with the first-party flash-attention kernel (proven on-chip by
+  TPU_SMOKE.json) — tokens/sec + MFU.
 
 Same resilience contract as bench.py: parent never imports jax, children
 run under the watchdog, artifact ``BENCH_LM.json`` always gets written.
@@ -20,7 +23,9 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 ARTIFACT = os.path.join(ROOT, "BENCH_LM.json")
 SENTINEL = "BENCH_LM_ROW "
-CHILD_TIMEOUT_S = 900
+# 1800 s: the child compiles TWICE on slow axon compiles (the jit itself +
+# cost_analysis's lower().compile()) — 900 s was not enough for BERT-base.
+CHILD_TIMEOUT_S = 1800
 V5E_PEAK_BF16_FLOPS = 197e12
 
 
@@ -68,6 +73,27 @@ def child():
         row.update(batch=batch, seq=seq, grad_accum=accum,
                    n_params=int(n_params), zero1=True)
         unit_scale = batch * seq  # tokens per step
+    elif which == "gpt":
+        from dtf_tpu.data.synthetic import SyntheticData
+        from dtf_tpu.models import gpt
+
+        tiny = os.environ.get("DTF_LM_TINY") == "1"  # CPU-sim logic check
+        batch = int(os.environ.get("DTF_LM_BATCH", "8"))
+        seq = int(os.environ.get("DTF_LM_SEQ", "64" if tiny else "1024"))
+        cfg = gpt.GPTConfig.tiny() if tiny else gpt.GPTConfig.gpt2_small()
+        model, init_fn = gpt.make_init(cfg, mesh, seq_len=seq)
+        tx = optax.adamw(1e-4, weight_decay=0.01)
+        state, shardings = tr.create_train_state(
+            init_fn, tx, jax.random.PRNGKey(0), mesh,
+            param_rules=gpt.tp_rules, zero1=True)
+        step = tr.make_train_step(gpt.make_loss(model), tx, mesh, shardings,
+                                  log_grad_norm=False)
+        data = shard_batch(
+            SyntheticData("gpt", batch, seed=0, seq_len=seq,
+                          vocab_size=cfg.vocab_size).batch(0), mesh)
+        row.update(batch=batch, seq=seq, attn="flash(auto)",
+                   n_params=int(_count_params(state.params)), zero1=True)
+        unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
 
@@ -110,11 +136,13 @@ def child():
 
     per_sec = unit_scale * n_steps / dt
     row["sec_per_step"] = round(dt / n_steps, 5)
-    if which == "bert":
+    if which in ("bert", "gpt"):
         row["tokens_per_sec"] = round(per_sec, 1)
         # analytic: 6 FLOPs per param per token (fwd+bwd, weight FLOPs) +
-        # attention 12*L*h*s per token
-        att = 12 * cfg.layers * cfg.hidden * row["seq"]
+        # attention 12*L*d*s per token
+        layers = cfg.layers
+        width = cfg.hidden if which == "bert" else cfg.d_model
+        att = 12 * layers * width * row["seq"]
         flops_tok = 6 * row["n_params"] + att
         row["mfu_analytic"] = round(
             per_sec * flops_tok / V5E_PEAK_BF16_FLOPS, 4)
@@ -129,7 +157,8 @@ def child():
 def main():
     from _dtf_watchdog import child_argv, run_watchdogged
 
-    jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"}]
+    jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"},
+            {"DTF_LM_WHICH": "gpt"}]
     rows, errors = [], []
     for env_extra in jobs:
         env = dict(os.environ)
@@ -138,7 +167,7 @@ def main():
             child_argv(os.path.abspath(__file__)),
             lambda line: (json.loads(line[len(SENTINEL):])
                           if line.startswith(SENTINEL) else None),
-            timeout_s=CHILD_TIMEOUT_S, retries=3, backoff_s=15, env=env)
+            timeout_s=CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
         (rows.append(row) if row is not None
          else errors.append({"env": env_extra, "errors": errs}))
         with open(ARTIFACT, "w") as f:
