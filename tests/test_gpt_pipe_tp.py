@@ -35,11 +35,11 @@ def _batches(cfg, n, batch=16, t=16):
     return out
 
 
-def _run_steps(loss_fn, init_fn, mesh, rules, batches):
+def _run_steps(loss_fn, init_fn, mesh, rules, batches, *, zero1=False):
     tx = optax.sgd(0.1)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(0), mesh, param_rules=rules,
-        zero1=False)
+        zero1=zero1)
     step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                               log_grad_norm=False)
     losses = []
@@ -60,6 +60,22 @@ def test_tp_in_pipe_matches_sequential():
     want = _run_steps(
         gpt_pipe_tp.make_sequential_tp_loss(cfg, 2),
         init_fn, mesh, gpt_pipe_tp.pipe_tp_rules(), batches)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_tp_in_pipe_with_zero1_matches_sequential():
+    """ZeRO-1 optimizer sharding under TP x PP: the weight-update sharding
+    must not change the numbers (same losses as the unsharded oracle)."""
+    cfg = dataclasses.replace(_tiny(), layers=4)
+    mesh = make_mesh(MeshConfig(data=2, pipe=2, model=2))
+    batches = _batches(cfg, 2)
+    init_fn = gpt_pipe_tp.make_pipe_tp_init(cfg, mesh, seq_len=16)
+    got = _run_steps(
+        gpt_pipe_tp.make_pipe_tp_loss(cfg, mesh, n_microbatches=4),
+        init_fn, mesh, gpt_pipe_tp.pipe_tp_rules(), batches, zero1=True)
+    want = _run_steps(
+        gpt_pipe_tp.make_sequential_tp_loss(cfg, 2),
+        init_fn, mesh, gpt_pipe_tp.pipe_tp_rules(), batches, zero1=False)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
