@@ -20,6 +20,7 @@ import numpy as np
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "_mp_worker.py")
 WORKER_BERT = os.path.join(ROOT, "tests", "_mp_worker_bert.py")
+WORKER_PIPE = os.path.join(ROOT, "tests", "_mp_worker_pipe.py")
 
 
 def _free_port():
@@ -159,6 +160,64 @@ def _reference_bert_losses():
         state, metrics = step(state, shard_batch(batch, mesh))
         losses.append(float(metrics["loss"]))
     return losses
+
+
+def _reference_pipe_losses():
+    """Single-process (data=2, pipe=2) run on the concatenated batches."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.comms import shard_batch
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.models import gpt, gpt_pipe
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
+    cfg = gpt.GPTConfig.tiny(attn_impl="dense", dtype=jnp.float32)
+    init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16)
+    tx = optax.sgd(0.1)
+    state, shardings = tr.create_train_state(
+        init_fn, tx, jax.random.PRNGKey(0), mesh,
+        param_rules=gpt_pipe.pipe_rules(), zero1=False)
+    step = tr.make_train_step(
+        gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4), tx, mesh,
+        shardings, log_grad_norm=False)
+    streams = [SyntheticData("gpt", 16, seed=0, seq_len=16,
+                             vocab_size=cfg.vocab_size, host_index=h,
+                             host_count=2) for h in range(2)]
+    losses = []
+    for i in range(5):
+        b0, b1 = streams[0].batch(i), streams[1].batch(i)
+        batch = {k: np.concatenate([b0[k], b1[k]]) for k in b0}
+        state, metrics = step(state, shard_batch(batch, mesh))
+        losses.append(float(metrics["loss"]))
+    return losses
+
+
+def test_two_process_pipeline_parallel_matches_single_process(tmp_path):
+    """The GPipe ppermute hop across a REAL process boundary: 2 processes x
+    2 devices form mesh (data=2, pipe=2); stage 0 lives in one OS process
+    and stage 1 in the other, activations cross via the coordination
+    service's transport. Losses must be identical on both workers and match
+    the single-process run bit-for-bit in semantics (1e-5 in f32)."""
+    port = _free_port()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER_PIPE, str(i), "2", str(port)],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=360)
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+    l0, l1 = _parse_losses(outs[0]), _parse_losses(outs[1])
+    np.testing.assert_allclose(l0, l1, rtol=0, atol=0)
+    np.testing.assert_allclose(l0, _reference_pipe_losses(), rtol=1e-5)
 
 
 def test_two_process_tp_zero1_bert_with_cross_host_checkpoint(tmp_path):
