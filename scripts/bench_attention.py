@@ -128,63 +128,98 @@ def cpu_main():
 # --------------------------------------------------------------- real TPU
 
 def tpu_child():
-    """ONE sequence length per child (DTF_ATTN_SEQ): the full 4-seq matrix
-    is ~16 slow axon compiles and blew the 900 s watchdog three times in a
-    row; per-seq children keep each attempt at 4 compiles."""
+    """ONE sequence length per child (DTF_ATTN_SEQ); ~5 axon compiles each.
+
+    Timing method (round-3 fix): a single call over the axon tunnel costs a
+    ~75 ms round trip, which swamped kernel time — the first committed rows
+    were FLAT from seq 1k to 4k (16x the FLOPs, same wall time). So each
+    measurement folds ``reps`` iterations into ONE jitted ``lax.scan`` whose
+    carry feeds the output back into the next iteration's query (scaled by
+    1e-30 — numerically a no-op in bf16, but XLA cannot hoist the
+    loop-invariant compute out of the scan). Per-iter time is
+    (scan_time - null_jit_time) / reps, with the tunnel round trip measured
+    by a trivial jitted readback and reps scaled so kernel FLOPs dominate.
+    """
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from dtf_tpu.ops import attention as att
     from dtf_tpu.ops import flash_attention as fa
 
     b, h, d = 2, 8, 128
     t = int(os.environ["DTF_ATTN_SEQ"])
+    EPS = 1e-30  # representable in bf16; underflows at runtime, opaque to XLA
 
-    def fence_timed(fn, *args, reps=5):
-        # scalar-readback fence: float() cannot return before the compute.
-        float(fn(*args))
+    def med_timed(fn, *args, n=3):
+        float(fn(*args))  # compile + warm
         ts = []
-        for _ in range(reps):
+        for _ in range(n):
             t0 = time.perf_counter()
             float(fn(*args))
             ts.append(time.perf_counter() - t0)
         return statistics.median(ts)
 
+    # tunnel round-trip baseline: same dispatch+readback path, ~zero compute
+    null_s = med_timed(jax.jit(lambda x: x * 2.0), jnp.float32(1.0), n=5)
+
+    def scan_timed(step, q0, reps):
+        @jax.jit
+        def loop(q):
+            out, _ = lax.scan(lambda c, _: (step(c), None), q, None,
+                              length=reps)
+            return out.astype(jnp.float32).sum()
+        total = med_timed(loop, q0)
+        return max(total - null_s, 0.0) / reps
+
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.bfloat16)
                for kk in ks)
 
-    def fwd(impl):
-        def f(q, k, v):
-            o = impl(q, k, v)
-            return o.astype(jnp.float32).sum()
-        return jax.jit(f)
+    def fwd_step(impl):
+        return lambda c: c + impl(c, k, v) * EPS
 
-    def fwdbwd(impl):
+    def fwdbwd_step(impl):
         def loss(q, k, v):
             return impl(q, k, v).astype(jnp.float32).sum()
 
-        def f(q, k, v):
-            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
-            return (dq.astype(jnp.float32).sum()
-                    + dk.astype(jnp.float32).sum()
-                    + dv.astype(jnp.float32).sum())
-        return jax.jit(f)
+        def step(c):
+            dq, dk, dv = jax.grad(loss, argnums=(0, 1, 2))(c, k, v)
+            return c + (dq + dk + dv) * EPS
+        return step
 
     flash = lambda q, k, v: fa.flash_attention(  # noqa: E731
         q, k, v, causal=True, interpret=False)
     dense = lambda q, k, v: att.dense_attention(  # noqa: E731
         q, k, v, causal=True)
 
+    # reps: enough kernel FLOPs that the subtracted tunnel overhead is noise
+    fwd_flops = 4 * b * h * t * t * d  # causal halves it; keep conservative
+    def reps_for(flops):
+        return max(8, min(512, int(4e12 / flops)))
+    r_fwd, r_bwd = reps_for(fwd_flops), reps_for(3.5 * fwd_flops)
+
+    # dense materializes f32 scores [b,h,t,t]; past ~6 GB it cannot fit v5e
+    # HBM alongside operands — record that as the finding, don't crash.
+    dense_ok = b * h * t * t * 4 < 6e9
+
     row = {"seq": t, "backend": jax.default_backend(), "b": b, "h": h,
-           "d": d, "dtype": "bfloat16"}
-    row["flash_fwd_s"] = round(fence_timed(fwd(flash), q, k, v), 5)
-    row["dense_fwd_s"] = round(fence_timed(fwd(dense), q, k, v), 5)
-    row["flash_fwdbwd_s"] = round(fence_timed(fwdbwd(flash), q, k, v), 5)
-    row["dense_fwdbwd_s"] = round(fence_timed(fwdbwd(dense), q, k, v), 5)
-    row["fwd_speedup"] = round(row["dense_fwd_s"] / row["flash_fwd_s"], 3)
-    row["fwdbwd_speedup"] = round(
-        row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
+           "d": d, "dtype": "bfloat16", "null_jit_s": round(null_s, 5),
+           "reps_fwd": r_fwd, "reps_fwdbwd": r_bwd}
+    row["flash_fwd_s"] = round(scan_timed(fwd_step(flash), q, r_fwd), 6)
+    row["flash_fwdbwd_s"] = round(scan_timed(fwdbwd_step(flash), q, r_bwd), 6)
+    if dense_ok:
+        row["dense_fwd_s"] = round(scan_timed(fwd_step(dense), q, r_fwd), 6)
+        row["dense_fwdbwd_s"] = round(
+            scan_timed(fwdbwd_step(dense), q, r_bwd), 6)
+        row["fwd_speedup"] = round(row["dense_fwd_s"] / row["flash_fwd_s"], 3)
+        row["fwdbwd_speedup"] = round(
+            row["dense_fwdbwd_s"] / row["flash_fwdbwd_s"], 3)
+    else:
+        row["dense_skipped"] = "f32 scores [b,h,t,t] exceed v5e HBM"
+    # achieved TFLOP/s on the causal-true FLOP count (half the full matrix)
+    row["flash_fwd_tflops"] = round(
+        0.5 * fwd_flops / row["flash_fwd_s"] / 1e12, 2)
     print(SENTINEL + json.dumps(row))
 
 
@@ -192,7 +227,7 @@ def tpu_main():
     from _dtf_watchdog import run_watchdogged
 
     rows, errs_all = [], []
-    for t in (1024, 2048, 4096, 8192):
+    for t in (1024, 2048, 4096, 8192, 16384, 32768):
         env = dict(os.environ)
         env["DTF_ATTN_SEQ"] = str(t)
         row, errors = run_watchdogged(
