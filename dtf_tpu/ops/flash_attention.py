@@ -40,8 +40,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# 512x512 blocks: a 128x128 grid step is ~40ns of MXU work vs ~1us of grid
+# overhead, so the kernel was overhead-bound (measured ~10 TF/s flat across
+# seq lengths, ATTN_BENCH.json r3). 512x512 cuts grid steps 16x while all
+# VMEM residents (f32 scores 1MB, acc 512xd, k/v blocks) stay far under the
+# ~16MB budget. Callers can still override per-shape.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 _NEG_INF = float("-inf")
 _STAT_LANES = 128  # scratch stat arrays are [block_q, 128] (TPU lane width)
 
