@@ -17,7 +17,9 @@ parallelism machinery together —
   long sequences need.
 
 Pre-LN blocks, RoPE positions (global positions, so they are correct under
-sequence sharding), untied LM head, bf16 compute / f32 params.
+sequence sharding), optional grouped-query attention (``kv_heads`` — the
+KV cache shrinks by heads/kv_heads, the decode-memory lever), untied LM
+head, bf16 compute / f32 params.
 """
 
 from __future__ import annotations
@@ -48,6 +50,11 @@ class GPTConfig:
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.bfloat16
     rope_theta: float = 10000.0
+    #: grouped-query attention: number of shared K/V heads (None = heads,
+    #: i.e. plain MHA). Must divide ``heads``. The KV cache shrinks by
+    #: heads/kv_heads — the decode-memory lever (cache is the decode
+    #: footprint at long ``decode_len``).
+    kv_heads: Optional[int] = None
     #: attention backend: auto (ring if seq-sharded, flash on tpu, else
     #: dense), or force one of dense|flash|ring.
     attn_impl: str = "auto"
@@ -59,6 +66,17 @@ class GPTConfig:
     #: >0 enables single-token decode mode with a KV cache of this length
     #: (the "cache" collection; see :func:`generate`).
     decode_len: int = 0
+
+    def __post_init__(self):
+        if self.kv_heads is not None and (
+                self.kv_heads < 1 or self.heads % self.kv_heads):
+            raise ValueError(
+                f"kv_heads={self.kv_heads} must be >=1 and divide "
+                f"heads={self.heads}")
+
+    @property
+    def kv_heads_resolved(self) -> int:
+        return self.heads if self.kv_heads is None else self.kv_heads
 
     @staticmethod
     def gpt2_small() -> "GPTConfig":
@@ -118,15 +136,25 @@ class CausalSelfAttention(nn.Module):
     def __call__(self, x, deterministic: bool):
         cfg = self.cfg
         d_head = cfg.d_model // cfg.heads
+        kv_heads = cfg.kv_heads_resolved
+        group = cfg.heads // kv_heads
         t = x.shape[1]
-        dense = lambda name: nn.Dense(  # noqa: E731
-            cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        dense = lambda name, nh: nn.Dense(  # noqa: E731
+            nh * d_head, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
 
-        def split(v):
-            return v.reshape(v.shape[0], t, cfg.heads, d_head).transpose(
-                0, 2, 1, 3)
+        def split(v, nh):
+            return v.reshape(v.shape[0], t, nh, d_head).transpose(0, 2, 1, 3)
 
-        q, k, v = (split(dense(n)(x)) for n in ("query", "key", "value"))
+        q = split(dense("query", cfg.heads)(x), cfg.heads)
+        k = split(dense("key", kv_heads)(x), kv_heads)
+        v = split(dense("value", kv_heads)(x), kv_heads)
+
+        def expand_kv(a):
+            # GQA: query head h reads shared K/V head h // group. jnp.repeat
+            # on the head axis produces exactly that alignment, and keeps
+            # head-sharded layouts consistent (shard s's q heads see shard
+            # s's repeated kv heads).
+            return jnp.repeat(a, group, axis=1) if group > 1 else a
 
         if cfg.decode_len > 0:
             # KV-cache decode: one token in, attend against all cached
@@ -142,10 +170,10 @@ class CausalSelfAttention(nn.Module):
             # would occupy slot 0 and every later step would be off by one.
             is_initialized = self.has_variable("cache", "cached_key")
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, cfg.heads, cfg.decode_len, d_head),
+                               (b, kv_heads, cfg.decode_len, d_head),
                                cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, cfg.heads, cfg.decode_len, d_head),
+                               (b, kv_heads, cfg.decode_len, d_head),
                                cfg.dtype)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
@@ -160,9 +188,19 @@ class CausalSelfAttention(nn.Module):
                     cv.value, v.astype(cfg.dtype), idx, axis=2)
                 ci.value = idx + 1
             valid = jnp.arange(cfg.decode_len) <= idx           # [L]
-            bias = jnp.where(valid, 0.0, -jnp.inf)[None, None, None, :]
-            out = att.dense_attention(q, ck.value, cv.value, bias=bias)
-            out = out.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+            bias = jnp.where(valid, 0.0, -jnp.inf)               # [L]
+            # Grouped attention straight against the un-expanded cache:
+            # materializing expand_kv(cache) would re-read group x the cache
+            # bytes per token per layer — the exact cost GQA removes. Query
+            # head h = kv*group + g reads shared head kv.
+            qg = q[:, :, 0, :].reshape(b, kv_heads, group, d_head)
+            s = jnp.einsum("bkgd,bkld->bkgl", qg, ck.value,
+                           preferred_element_type=jnp.float32)
+            s = s * d_head ** -0.5 + bias[None, None, None, :]
+            p = jax.nn.softmax(s, axis=-1)  # >=1 valid key: no dead rows
+            out = jnp.einsum("bkgl,bkld->bkgd", p.astype(cv.value.dtype),
+                             cv.value, preferred_element_type=jnp.float32)
+            out = out.astype(cfg.dtype).reshape(b, 1, cfg.d_model)
             return nn.Dense(cfg.d_model, dtype=cfg.dtype,
                             param_dtype=jnp.float32, name="attn_out")(out)
 
@@ -186,6 +224,9 @@ class CausalSelfAttention(nn.Module):
             positions = jnp.arange(t)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+        # expand AFTER rope (rope on kv_heads is cheaper); the repeat is a
+        # transient — the cache/params only ever hold kv_heads.
+        k, v = expand_kv(k), expand_kv(v)
 
         if impl == "zigzag":
             if seq_sharded:
@@ -328,9 +369,14 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
         if b % mesh.shape.get("data", 1):
             raise ValueError(f"decode batch {b} not divisible by the data "
                              f"axis ({mesh.shape.get('data', 1)})")
+        kv_heads = cfg.kv_heads_resolved
         if cfg.heads % mesh.shape.get("model", 1):
             raise ValueError(f"{cfg.heads} heads not divisible by the model "
                              f"axis ({mesh.shape.get('model', 1)})")
+        if kv_heads % mesh.shape.get("model", 1):
+            raise ValueError(f"{kv_heads} kv_heads not divisible by the "
+                             f"model axis ({mesh.shape.get('model', 1)}) — "
+                             "the cache shards heads over 'model'")
 
     # Build an all-zeros cache (index 0, no slots written) without
     # materialising a throwaway parameter set: eval_shape traces init

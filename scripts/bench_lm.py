@@ -157,8 +157,17 @@ def child():
 def main():
     from _dtf_watchdog import child_argv, run_watchdogged
 
-    jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"},
-            {"DTF_LM_WHICH": "gpt"}]
+    artifact = ARTIFACT
+    if "--sweep-gpt" in sys.argv:
+        # MFU search on the flagship: batch is the main lever on a single
+        # chip (seq is fixed by the config). Results land in a separate
+        # artifact; the best batch becomes the BENCH_LM default.
+        jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b)}
+                for b in (8, 16, 32, 64)]
+        artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
+    else:
+        jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"},
+                {"DTF_LM_WHICH": "gpt"}]
     rows, errors = [], []
     for env_extra in jobs:
         env = dict(os.environ)
@@ -170,7 +179,7 @@ def main():
             timeout_s=CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
         (rows.append(row) if row is not None
          else errors.append({"env": env_extra, "errors": errs}))
-        with open(ARTIFACT, "w") as f:
+        with open(artifact, "w") as f:
             json.dump({"rows": rows, "errors": errors}, f, indent=1)
         print(json.dumps(rows[-1] if row is not None else errors[-1]))
     return 0 if rows and not errors else 1

@@ -29,6 +29,8 @@ flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
 flags.DEFINE_integer("moe_every", 0, "every k-th block uses Switch-MoE "
                      "(0 = dense)")
 flags.DEFINE_boolean("remat", False, "jax.checkpoint each block")
+flags.DEFINE_integer("kv_heads", 0, "grouped-query attention: shared K/V "
+                     "heads (0 = plain MHA; must divide heads)")
 flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
                     "zigzag (load-balanced causal ring; needs mesh_seq>1)")
 flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
@@ -63,7 +65,8 @@ def main(argv):
     import dataclasses
 
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,
-                              remat=FLAGS.remat, attn_impl=FLAGS.attn_impl)
+                              remat=FLAGS.remat, attn_impl=FLAGS.attn_impl,
+                              kv_heads=FLAGS.kv_heads or None)
     tx = optax.adamw(
         optax.warmup_cosine_decay_schedule(
             0.0, FLAGS.learning_rate,
