@@ -387,14 +387,40 @@ class CriteoCsvData(ShardedEpochs):
                    "label": np.asarray(self.labels[idx])}
 
 
+def _tfrecord_train_pattern(data_dir: str) -> Optional[str]:
+    """TFRecord shard pattern for the train split, or None.
+
+    Prefers ``train*``-prefixed shards; falls back to any ``*.tfrecord*``
+    only when no split-prefixed files exist (an unsplit dump), so an
+    eval-only directory is never mistaken for training data."""
+    pat = os.path.join(data_dir, "train*.tfrecord*")
+    if glob.glob(pat):
+        return pat
+    anyp = os.path.join(data_dir, "*.tfrecord*")
+    files = glob.glob(anyp)
+    prefixed = any(os.path.basename(f).startswith(("test", "validation",
+                                                   "val", "eval"))
+                   for f in files)
+    return anyp if files and not prefixed else None
+
+
 def detect_image_data(data_dir: str, batch_size: int, **kw) -> Optional[object]:
-    """npy pair > CIFAR binary > None, for the resnet script."""
+    """npy pair > CIFAR binary > TFRecord shards > None, for the resnet
+    script. TFRecord Examples use the conventional image/label (+
+    height/width/depth) keys — the reference-era dump format."""
     if not data_dir:
         return None
     if NpyImageData.available(data_dir):
         return NpyImageData(data_dir, batch_size, **kw)
     if CifarBinData.available(data_dir):
         return CifarBinData(data_dir, batch_size, **kw)
+    pat = _tfrecord_train_pattern(data_dir)
+    if pat:
+        from dtf_tpu.data.tfrecord import (TFRecordExampleData,
+                                           image_example_transform)
+
+        return TFRecordExampleData(pat, batch_size,
+                                   transform=image_example_transform(), **kw)
     return None
 
 
@@ -409,6 +435,14 @@ def detect_image_eval_data(data_dir: str, batch_size: int,
         return NpyImageData(data_dir, batch_size, split="test", **kw)
     if os.path.exists(os.path.join(data_dir, "test_batch.bin")):
         return CifarBinData(data_dir, batch_size, split="test", **kw)
+    for split in ("test", "validation", "val", "eval"):
+        pat = os.path.join(data_dir, f"{split}*.tfrecord*")
+        if glob.glob(pat):
+            from dtf_tpu.data.tfrecord import (TFRecordExampleData,
+                                               image_example_transform)
+
+            return TFRecordExampleData(
+                pat, batch_size, transform=image_example_transform(), **kw)
     return None
 
 
