@@ -30,6 +30,13 @@ class ShardedEpochs:
         if batch_size % host_count:
             raise ValueError(f"global batch {batch_size} not divisible by "
                              f"{host_count} hosts")
+        if n_rows // host_count < batch_size // host_count:
+            # _indices() yields nothing when a host shard can't fill one
+            # batch, and the epoch while-loop would then busy-spin forever —
+            # an empty/undersized dataset must fail loudly instead.
+            raise ValueError(
+                f"dataset has {n_rows} rows — too few to fill one batch of "
+                f"{batch_size} across {host_count} host(s)")
         self.n_rows = n_rows
         self.local_batch = batch_size // host_count
         self.seed = seed

@@ -283,24 +283,37 @@ def write_tfrecords(path: str, payloads: Iterable[bytes]) -> int:
 
 
 def _python_spans(path: str):
-    """Fallback framing walk (no compiler): verifies length CRCs only."""
+    """Fallback framing walk (no compiler): verifies length CRCs only.
+
+    mmap-backed so only the 12-byte headers are ever resident — a
+    migration-sized shard must not be slurped into RAM just to index it."""
+    import mmap
+
     off: List[int] = []
     length: List[int] = []
     with open(path, "rb") as f:
-        raw = f.read()
-    pos, total = 0, len(raw)
-    while pos < total:
-        if total - pos < 12:
-            raise ValueError(f"{path}: truncated record header at {pos}")
-        (n,) = struct.unpack_from("<Q", raw, pos)
-        (lcrc,) = struct.unpack_from("<I", raw, pos + 8)
-        if lcrc != masked_crc32c(raw[pos:pos + 8]):
-            raise ValueError(f"{path}: length CRC mismatch at {pos}")
-        if total - pos - 12 < n + 4:
-            raise ValueError(f"{path}: truncated payload at {pos}")
-        off.append(pos + 12)
-        length.append(n)
-        pos += 12 + n + 4
+        f.seek(0, 2)
+        total = f.tell()
+        if total == 0:
+            return (np.asarray([], np.uint64), np.asarray([], np.uint64))
+        raw = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        try:
+            pos = 0
+            while pos < total:
+                if total - pos < 12:
+                    raise ValueError(
+                        f"{path}: truncated record header at {pos}")
+                (n,) = struct.unpack_from("<Q", raw, pos)
+                (lcrc,) = struct.unpack_from("<I", raw, pos + 8)
+                if lcrc != masked_crc32c(raw[pos:pos + 8]):
+                    raise ValueError(f"{path}: length CRC mismatch at {pos}")
+                if total - pos - 12 < n + 4:
+                    raise ValueError(f"{path}: truncated payload at {pos}")
+                off.append(pos + 12)
+                length.append(n)
+                pos += 12 + n + 4
+        finally:
+            raw.close()
     return (np.asarray(off, np.uint64), np.asarray(length, np.uint64))
 
 
@@ -308,8 +321,14 @@ def tfrecord_spans(path: str, *, verify_payload_crc: bool = True):
     """(offsets, lengths) of every record payload in ``path``.
 
     Uses the native indexer (CRC-verified single pass) when available,
-    else the pure-Python walk. Raises ValueError on corrupt framing.
+    else the pure-Python walk. Raises ValueError on corrupt framing,
+    FileNotFoundError/OSError on unreadable paths (stat'd up front so the
+    native path's opaque nullptr can't misreport a typo'd path as
+    corruption).
     """
+    import os
+
+    os.stat(path)  # raises FileNotFoundError/PermissionError consistently
     from dtf_tpu.data import native as native_mod
 
     lib = native_mod._load()

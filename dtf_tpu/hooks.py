@@ -10,6 +10,7 @@ nothing on the device timeline unless they block on results.
 
 from __future__ import annotations
 
+import signal
 import time
 from typing import Any, Mapping
 
@@ -101,6 +102,48 @@ class CheckpointHook(Hook):
     def end(self, state):
         self.ckpt.save(int(state.step), state, force=True)
         self.ckpt.wait()
+
+
+class PreemptionHook(Hook):
+    """Graceful-preemption checkpointing: SIGTERM → save → clean stop.
+
+    Cloud TPU / GKE evictions deliver SIGTERM with a grace window before the
+    SIGKILL; the reference era's ``_RecoverableSession`` only covered the
+    crash side. The handler just sets a flag (async-signal-safe); the loop
+    notices at the next step boundary, force-saves the exact current step,
+    blocks until the write is durable, and raises :class:`StopTraining` —
+    the relaunch then resumes with zero lost steps (vs. up to
+    ``checkpoint_every - 1`` lost on a plain kill; that crash path is
+    exercised by tests/test_fault_injection.py).
+
+    Must be constructed and ``begin()``-run in the main thread (CPython's
+    ``signal.signal`` requirement). Restores the previous handlers at
+    ``end()`` so short-lived Trainers don't leak handler state.
+    """
+
+    def __init__(self, ckpt: Checkpointer, signals=(signal.SIGTERM,)):
+        self.ckpt = ckpt
+        self.signals = tuple(signals)
+        self.preempted = False
+        self._prev: dict = {}
+
+    def begin(self, state):
+        for s in self.signals:
+            self._prev[s] = signal.signal(s, self._on_signal)
+
+    def _on_signal(self, signum, frame):
+        self.preempted = True
+
+    def after_step(self, step, state, metrics):
+        if self.preempted:
+            self.ckpt.save(step, state, force=True)
+            self.ckpt.wait()
+            raise StopTraining
+
+    def end(self, state):
+        for s, prev in self._prev.items():
+            signal.signal(s, prev)
+        self._prev.clear()
 
 
 class EvalHook(Hook):

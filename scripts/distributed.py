@@ -44,7 +44,8 @@ def main(argv):
     from dtf_tpu.core import train as tr
     from dtf_tpu.data import mnist as mnist_data
     from dtf_tpu.data.synthetic import SyntheticData
-    from dtf_tpu.hooks import CheckpointHook, LoggingHook, StopAtStepHook
+    from dtf_tpu.hooks import (CheckpointHook, LoggingHook,
+                               PreemptionHook, StopAtStepHook)
     from dtf_tpu.loop import Trainer
     from dtf_tpu.metrics import MetricWriter
     from dtf_tpu.models import mnist as mnist_model
@@ -90,6 +91,7 @@ def main(argv):
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
+               PreemptionHook(ckpt),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt)

@@ -41,7 +41,7 @@ def main(argv):
     from dtf_tpu.data.synthetic import SyntheticData
     from dtf_tpu.core.comms import shard_batch
     from dtf_tpu.hooks import (CheckpointHook, EvalHook, LoggingHook,
-                               StopAtStepHook)
+                               PreemptionHook, StopAtStepHook)
     from dtf_tpu.loop import Trainer
     from dtf_tpu.metrics import MetricWriter
     from dtf_tpu.models import resnet
@@ -117,6 +117,7 @@ def main(argv):
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
+               PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],

@@ -47,6 +47,44 @@ def _has_checkpoint(logdir):
     return any(d.isdigit() for d in os.listdir(ckpt_dir))
 
 
+def test_sigterm_saves_current_step_and_resumes(tmp_path):
+    """Graceful preemption (PreemptionHook): SIGTERM mid-run must save the
+    EXACT in-flight step (not just the last periodic save), exit 0, and a
+    relaunch must resume from it. checkpoint_every is huge so any durable
+    step beyond 0 can only have come from the preemption save."""
+    logdir = str(tmp_path / "run")
+    p = subprocess.Popen(
+        [sys.executable, SCRIPT, "--backend=cpu", f"--logdir={logdir}",
+         "--train_steps=100000", "--batch_size=32",
+         "--checkpoint_every=100000", "--log_every=5"],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        # give it time to compile + take some steps, then "preempt"
+        for _ in range(30):
+            if p.poll() is not None:
+                pytest.fail(f"trainer exited early ({p.returncode}):\n"
+                            f"{p.stdout.read()[-2000:]}")
+            time.sleep(1.0)
+        os.kill(p.pid, signal.SIGTERM)
+        out, _ = p.communicate(timeout=300)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, out[-2000:]
+    assert _has_checkpoint(logdir), "preemption save did not land"
+    saved = max(int(d) for d in os.listdir(os.path.join(logdir, "ckpt"))
+                if d.isdigit())
+    assert saved >= 1, "preemption save happened before any step"
+
+    # relaunch: must resume from exactly the preemption step and finish
+    p2 = _launch(logdir, steps=saved + 5)
+    out2, _ = p2.communicate(timeout=300)
+    assert p2.returncode == 0, out2[-2000:]
+    assert f"resumed from checkpoint at step {saved}" in out2, out2[-2000:]
+    assert f"done: step={saved + 5}" in out2, out2[-2000:]
+
+
 def test_sigkill_and_resume(tmp_path):
     logdir = str(tmp_path / "run")
 

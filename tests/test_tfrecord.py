@@ -225,3 +225,20 @@ def test_detect_image_data_finds_tfrecords_with_shape_features(tmp_path):
 def test_missing_pattern_raises():
     with pytest.raises(FileNotFoundError):
         tfr.TFRecordExampleData("/nonexistent/*.tfrecord", 4, lambda e: e)
+
+
+def test_missing_file_raises_filenotfound_not_corruption():
+    # the native indexer's nullptr is opaque; a typo'd path must not be
+    # reported as a corrupt dataset
+    with pytest.raises(FileNotFoundError):
+        tfr.tfrecord_spans("/nonexistent/shard.tfrecord")
+
+
+def test_undersized_dataset_fails_loudly(tmp_path):
+    """n_rows < batch must raise at construction, not busy-spin in iter."""
+    path = tmp_path / "train-tiny.tfrecord"
+    tfr.write_tfrecords(str(path), [tfr.encode_example(
+        {"image": [bytes(12)], "label": np.asarray([0], np.int64)})])
+    with pytest.raises(ValueError, match="too few"):
+        tfr.TFRecordExampleData(str(path), batch_size=4,
+                                transform=tfr.image_example_transform(2, 2))
