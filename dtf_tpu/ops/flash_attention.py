@@ -92,8 +92,10 @@ def _zero_padded_q_rows(p, i, *, block_q, t_q):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
-                sm_scale, causal, block_q, block_k, num_k, t_q, t_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_q,
+                block_k, num_k, t_q, t_k, has_mask):
+    mb_ref = rest[0] if has_mask else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[1:] if has_mask else rest
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -112,6 +114,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
             preferred_element_type=jnp.float32) * sm_scale
         s = _score_mask(s, i, j, causal=causal, block_q=block_q,
                         block_k=block_k, t_k=t_k)
+        if has_mask:
+            # additive key-padding bias row (0 valid / -inf padded): the
+            # existing -inf machinery (running max, dead-row guards) then
+            # handles masked keys identically to causal-masked ones.
+            s = s + mb_ref[0, 0][None, :]
         m_prev = m_scr[:, 0:1]
         l_prev = l_scr[:, 0:1]
         m_next = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -142,7 +149,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr, *,
         lse_ref[0, 0, 0, :] = lse[:, 0]
 
 
-def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
+def _mask_bias(kv_mask, b, t_k, block_k):
+    """[b, 1, t_k_padded] f32 additive bias: 0 valid, -inf padded key.
+
+    PER-BATCH, not per-(batch*head): every head reads the same row, so the
+    kernels' index maps divide the bh grid index by the head count instead
+    of materializing h identical copies (which the custom_vjp residuals
+    would otherwise keep alive through the backward). Shaped with a size-1
+    middle axis so the (1, 1, block_k) BlockSpec's trailing dims are
+    (1, block_k) — the 1 is full-size, keeping the block Mosaic-legal
+    (same trick as the lse residual layout)."""
+    bias = jnp.where(kv_mask, 0.0, _NEG_INF).astype(jnp.float32)
+    return _pad(bias.reshape(b, 1, t_k), block_k, axis=2)
+
+
+def _fwd(q, k, v, mask_bias, *, sm_scale, causal, block_q, block_k,
+         interpret):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     num_q = pl.cdiv(t_q, block_q)
@@ -150,18 +172,27 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
     qp = _pad(q, block_q, axis=1)
     kp = _pad(k, block_k, axis=1)
     vp = _pad(v, block_k, axis=1)
+    has_mask = mask_bias is not None
 
     kern = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k)
+        block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k, has_mask=has_mask)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [qp, kp, vp]
+    if has_mask:
+        heads = bh // mask_bias.shape[0]  # bias rows are per-batch
+        in_specs.append(
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j: (b // heads, 0, j)))
+        inputs.append(mask_bias)
     out, lse = pl.pallas_call(
         kern,
         grid=(bh, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j: (b, i, 0, 0)),
@@ -177,7 +208,7 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
         ],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp)
+    )(*inputs)
     return out[:, :t_q], lse.reshape(bh, num_q * block_q)[:, :t_q]
 
 
@@ -186,8 +217,10 @@ def _fwd(q, k, v, *, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, sm_scale, causal, block_q, block_k, num_k, t_q, t_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               sm_scale, causal, block_q, block_k, num_k, t_q, t_k, has_mask):
+    mb_ref = rest[0] if has_mask else None
+    dq_ref, dq_scr = rest[1:] if has_mask else rest
     i, j = pl.program_id(1), pl.program_id(2)
 
     @pl.when(j == 0)
@@ -206,7 +239,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32) * sm_scale
         s = _score_mask(s, i, j, causal=causal, block_q=block_q,
                         block_k=block_k, t_k=t_k)
-        p = _zero_padded_q_rows(jnp.exp(s - lse), i, block_q=block_q, t_q=t_q)
+        if has_mask:
+            s = s + mb_ref[0, 0][None, :]
+        # a fully-masked VALID q row has lse == -inf; exp(s - lse) would be
+        # exp(-inf + inf) = nan — force p = 0 there (output was 0 too).
+        p = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(s - lse))
+        p = _zero_padded_q_rows(p, i, block_q=block_q, t_q=t_q)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         ds = p * (dp - delta) * sm_scale
@@ -219,9 +257,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
-                dv_ref, dk_scr, dv_scr, *, sm_scale, causal, block_q, block_k,
-                num_q, t_q, t_k):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                sm_scale, causal, block_q, block_k, num_q, t_q, t_k,
+                has_mask):
+    mb_ref = rest[0] if has_mask else None
+    dk_ref, dv_ref, dk_scr, dv_scr = rest[1:] if has_mask else rest
     j, i = pl.program_id(1), pl.program_id(2)  # k-block outer, q-block inner
 
     @pl.when(i == 0)
@@ -241,7 +281,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
             preferred_element_type=jnp.float32) * sm_scale
         s = _score_mask(s, i, j, causal=causal, block_q=block_q,
                         block_k=block_k, t_k=t_k)
-        p = _zero_padded_q_rows(jnp.exp(s - lse), i, block_q=block_q, t_q=t_q)
+        if has_mask:
+            s = s + mb_ref[0, 0][None, :]
+        p = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(s - lse))
+        p = _zero_padded_q_rows(p, i, block_q=block_q, t_q=t_q)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -258,23 +301,31 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
-         interpret):
+def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, block_q,
+         block_k, interpret):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     num_q = pl.cdiv(t_q, block_q)
     num_k = pl.cdiv(t_k, block_k)
+    has_mask = mask_bias is not None
     # delta = rowsum(dO * O): cheap elementwise+reduce, XLA fuses it fine.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
     qp, dop = _pad(q, block_q, 1), _pad(do, block_q, 1)
     kp, vp = _pad(k, block_k, 1), _pad(v, block_k, 1)
     lsep = _pad(lse, block_q, 1).reshape(bh, num_q, 1, block_q)
     deltap = _pad(delta, block_q, 1).reshape(bh, num_q, 1, block_q)
+    mask_in = [mask_bias] if has_mask else []
+    heads = bh // mask_bias.shape[0] if has_mask else 1  # bias is per-batch
+
+    def mask_spec(index_map):
+        return ([pl.BlockSpec((1, 1, block_k), index_map)]
+                if has_mask else [])
 
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-            block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k),
+            block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k,
+            has_mask=has_mask),
         grid=(bh, num_q, num_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -283,18 +334,19 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j: (b, i, 0, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j: (b, i, 0, 0)),
-        ],
+        ] + mask_spec(lambda b, i, j: (b // heads, 0, j)),
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *mask_in)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-            block_k=block_k, num_q=num_q, t_q=t_q, t_k=t_k),
+            block_k=block_k, num_q=num_q, t_q=t_q, t_k=t_k,
+            has_mask=has_mask),
         grid=(bh, num_k, num_q),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -303,7 +355,7 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, j, i: (b, i, 0, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, j, i: (b, i, 0, 0)),
-        ],
+        ] + mask_spec(lambda b, j, i: (b // heads, 0, j)),
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -318,7 +370,7 @@ def _bwd(q, k, v, out, lse, do, *, sm_scale, causal, block_q, block_k,
         ],
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(qp, kp, vp, dop, lsep, deltap)
+    )(qp, kp, vp, dop, lsep, deltap, *mask_in)
     return dq[:, :t_q], dk[:, :t_k], dv[:, :t_k]
 
 
@@ -336,23 +388,28 @@ def _pad(x, multiple, axis):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, sm_scale=sm_scale, causal=causal, block_q=block_q,
-                  block_k=block_k, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask_bias, causal, sm_scale, block_q, block_k,
+           interpret):
+    out, _ = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
+                  block_q=block_q, block_k=block_k, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, sm_scale=sm_scale, causal=causal,
+def _flash_fwd(q, k, v, mask_bias, causal, sm_scale, block_q, block_k,
+               interpret):
+    out, lse = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
                     block_q=block_q, block_k=block_k, interpret=interpret)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, mask_bias, out, lse)
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
-    q, k, v, out, lse = res
-    return _bwd(q, k, v, out, lse, do, sm_scale=sm_scale, causal=causal,
-                block_q=block_q, block_k=block_k, interpret=interpret)
+    q, k, v, mask_bias, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, mask_bias, out, lse, do, sm_scale=sm_scale,
+                      causal=causal, block_q=block_q, block_k=block_k,
+                      interpret=interpret)
+    dmb = None if mask_bias is None else jnp.zeros_like(mask_bias)
+    return dq, dk, dv, dmb
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -360,6 +417,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
+                    kv_mask: Optional[jax.Array] = None,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
@@ -368,6 +426,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
 
     ``sm_scale`` defaults to ``1/sqrt(head_dim)`` (the *original* head_dim,
     before any internal padding). Unaligned T is padded+masked internally.
+
+    ``kv_mask``: [B, T_k] bool, True = valid key (the BERT/encoder padding
+    mask). Rides through the kernels as a precomputed additive -inf bias
+    row. A query row whose keys are ALL masked produces output 0 and
+    gradient 0 (same contract as ``dense_attention``'s dead-row handling).
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, H, T, D], got shape {q.shape}")
@@ -379,5 +442,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     qr = q.reshape(b * h, t_q, d)
     kr = k.reshape(b * h, t_k, d)
     vr = v.reshape(b * h, t_k, d)
-    out = _flash(qr, kr, vr, causal, scale, block_q, block_k, interpret)
+    mask_bias = None
+    if kv_mask is not None:
+        if kv_mask.shape != (b, t_k):
+            raise ValueError(
+                f"kv_mask shape {kv_mask.shape} != (batch, t_k)=({b}, {t_k})")
+        mask_bias = _mask_bias(kv_mask, b, t_k, block_k)
+    out = _flash(qr, kr, vr, mask_bias, causal, scale, block_q, block_k,
+                 interpret)
     return out.reshape(b, h, t_q, d)

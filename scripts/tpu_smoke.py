@@ -97,6 +97,33 @@ def child():
                                vb.astype(jnp.float32), causal=True)
     ok &= record("flash_fwd_bf16_causal", o_fb, o_db, tol=5e-2)
 
+    # --- masked flash (BERT padding path) fwd+bwd vs dense+bias ---
+    t_m, b_m, h_m, d_m = 256, 2, 4, 128
+    qm = jax.random.normal(kq, (b_m, h_m, t_m, d_m), jnp.float32)
+    km = jax.random.normal(kk, (b_m, h_m, t_m, d_m), jnp.float32)
+    vm = jax.random.normal(kv, (b_m, h_m, t_m, d_m), jnp.float32)
+    mask = np.ones((b_m, t_m), bool)
+    mask[0, 150:] = False            # padded tail crossing block boundaries
+    mask = jnp.asarray(mask)
+    bias = jnp.where(mask[:, None, None, :], 0.0, -jnp.inf)
+
+    def loss_flash_m(q, k, v):
+        o = fa.flash_attention(q, k, v, kv_mask=mask, interpret=False)
+        return jnp.sum(o * (1 + jnp.cos(o))), o
+
+    def loss_dense_m(q, k, v):
+        o = att.dense_attention(q, k, v, bias=bias)
+        return jnp.sum(o * (1 + jnp.cos(o))), o
+
+    (_, o_fm), g_fm = jax.jit(jax.value_and_grad(
+        loss_flash_m, argnums=(0, 1, 2), has_aux=True))(qm, km, vm)
+    with jax.default_matmul_precision("highest"):
+        (_, o_dm), g_dm = jax.jit(jax.value_and_grad(
+            loss_dense_m, argnums=(0, 1, 2), has_aux=True))(qm, km, vm)
+    ok &= record("flash_fwd_kv_mask", o_fm, o_dm, tol=2e-2)
+    for gi, gn in zip(range(3), ("dq", "dk", "dv")):
+        ok &= record(f"flash_bwd_kv_mask_{gn}", g_fm[gi], g_dm[gi], tol=5e-2)
+
     # --- embed gather fwd + scatter-add bwd ---
     table = jax.random.normal(kt, (1000, 64), jnp.float32)
     ids = jax.random.randint(ki, (4, 37), 0, 1000)

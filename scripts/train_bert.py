@@ -8,6 +8,7 @@ state there), TP over `model` (Megatron rules), context parallelism over
 `seq` (ring attention).
 """
 
+import dataclasses
 import os
 import sys
 
@@ -23,6 +24,8 @@ dflags.define_train_flags(batch_size=64, learning_rate=1e-4, train_steps=200)
 flags.DEFINE_integer("seq_len", 128, "sequence length")
 flags.DEFINE_string("size", "base", "base | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
+flags.DEFINE_string("attn_impl", "auto", "auto (flash on TPU) | dense | "
+                    "flash — non-seq-sharded attention backend")
 FLAGS = flags.FLAGS
 
 
@@ -48,6 +51,7 @@ def main(argv):
 
     cfg = (bert.BertConfig.base() if FLAGS.size == "base"
            else bert.BertConfig.tiny())
+    cfg = dataclasses.replace(cfg, attn_impl=FLAGS.attn_impl)
     model, init_fn = bert.make_init(cfg, mesh if sp else None,
                                     seq_len=FLAGS.seq_len)
     tx = optax.adamw(

@@ -83,6 +83,58 @@ def test_bf16_close_to_f32_dense():
                                rtol=4e-2)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_kv_mask_matches_dense_bias(causal):
+    """Padding mask (kv_mask) == dense with a -inf bias, fwd and grads —
+    including a row with a masked tail crossing a block boundary."""
+    b, h, t, d = 2, 3, 67, 32
+    q, k, v = (_rand((b, h, t, d), jnp.float32, s) for s in range(3))
+    mask = np.ones((b, t), bool)
+    mask[0, 40:] = False            # crosses the 32-block boundary
+    mask[1, :5] = False             # masked head of the sequence
+    mask = jnp.asarray(mask)
+    bias = jnp.where(mask[:, None, None, :], 0.0, -jnp.inf)
+
+    want = dense_attention(q, k, v, causal=causal, bias=bias)
+    got = _flash(q, k, v, causal=causal, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+    f = lambda q, k, v: _flash(  # noqa: E731
+        q, k, v, causal=causal, kv_mask=mask).sum()
+    g = lambda q, k, v: dense_attention(  # noqa: E731
+        q, k, v, causal=causal, bias=bias).sum()
+    for a, b_ in zip(jax.grad(f, (0, 1, 2))(q, k, v),
+                     jax.grad(g, (0, 1, 2))(q, k, v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_kv_mask_all_masked_row_zero_output_and_grad():
+    """A sequence whose keys are ALL padded: output 0, grads finite and 0
+    into that sequence's K/V (the nan trap is exp(s - (-inf)) in the bwd)."""
+    b, h, t, d = 2, 2, 32, 16
+    q, k, v = (_rand((b, h, t, d), jnp.float32, s) for s in range(3))
+    mask = np.ones((b, t), bool)
+    mask[1, :] = False
+    mask = jnp.asarray(mask)
+    out = _flash(q, k, v, kv_mask=mask)
+    np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+    dq, dk, dv = jax.grad(
+        lambda q, k, v: _flash(q, k, v, kv_mask=mask).sum(),
+        (0, 1, 2))(q, k, v)
+    for g in (dq, dk, dv):
+        assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_array_equal(np.asarray(dk[1]), 0.0)
+    np.testing.assert_array_equal(np.asarray(dv[1]), 0.0)
+
+
+def test_kv_mask_shape_validated():
+    q, k, v = (_rand((2, 2, 16, 8), jnp.float32, s) for s in range(3))
+    with pytest.raises(ValueError, match="kv_mask"):
+        _flash(q, k, v, kv_mask=jnp.ones((2, 8), bool))
+
+
 def test_cross_attention_lengths():
     b, h, tq, tk, d = 1, 2, 33, 70, 16
     q = _rand((b, h, tq, d), jnp.float32, 40)
