@@ -50,9 +50,28 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000):
     flags.DEFINE_integer("checkpoint_every", 200, "steps between saves")
     flags.DEFINE_integer("log_every", 10, "steps between metric logs")
     flags.DEFINE_integer("grad_accum", 1, "gradient-accumulation microbatches")
+    flags.DEFINE_float("clip_grad_norm", 0.0, "clip gradients to this global "
+                       "norm before the optimizer update (0 = off)")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
     flags.DEFINE_integer("profile_steps", 0, "capture an XPlane profiler "
                          "trace spanning this many steps (0 = off); written "
                          "to <logdir>/profile")
     flags.DEFINE_integer("profile_start", 10, "step at which the profiler "
                          "trace window opens")
+
+
+def wrap_optimizer(tx, FLAGS):
+    """Apply the optimizer-shaping train flags to a base optax transform.
+
+    Today that is ``--clip_grad_norm`` (global-norm clipping BEFORE the
+    update, the standard transformer-training guard). Clipping composes
+    correctly with grad-accum (it sees the accumulated mean gradient) and
+    ZeRO-1 (optax transforms are pointwise over the sharded tree; the
+    global norm is computed with psum'd full gradients before sharding).
+    """
+    import optax
+
+    clip = getattr(FLAGS, "clip_grad_norm", 0.0)
+    if clip and clip > 0.0:
+        return optax.chain(optax.clip_by_global_norm(clip), tx)
+    return tx

@@ -1,0 +1,44 @@
+"""Device-side input prefetch — the H2D half of the queue-runner story.
+
+The native loader (``dtf_tpu/native/dtfio.cpp``) already assembles batches
+on a background host thread; this module overlaps the *host→device
+transfer* with the previous step's compute, the standard TPU input-pipeline
+double-buffer. ``jax.device_put`` dispatches asynchronously, so placing
+batch N+1 while step N runs costs nothing on the host and hides the PCIe
+copy behind the MXU time; the training loop then always finds a
+device-resident batch waiting.
+
+Reference capability replaced (SURVEY.md §2b N7): TF's ``FIFOQueue`` +
+``QueueRunner`` threads kept a staging area full between the input pipeline
+and the session step. Here the "queue" is the device's async transfer
+stream and ``depth`` bounds how many batches are in flight.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Iterable, Iterator, Optional
+
+Batch = object
+
+
+def prefetch_to_device(batches: Iterable[Batch],
+                       place: Callable[[Batch], Batch],
+                       depth: int = 2) -> Iterator[Batch]:
+    """Yield ``place(batch)`` with up to ``depth`` placements in flight.
+
+    ``place`` is the host→device mapping (e.g. ``Trainer.place_batch`` —
+    typically :func:`dtf_tpu.core.comms.shard_batch`). ``depth=1`` degrades
+    to the unpipelined behavior; ``depth=2`` (default) is classic double
+    buffering. Order is preserved; every input batch is yielded exactly
+    once.
+    """
+    if depth < 1:
+        raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+    queue: collections.deque = collections.deque()
+    for batch in batches:
+        queue.append(place(batch))
+        if len(queue) >= depth:
+            yield queue.popleft()
+    while queue:
+        yield queue.popleft()

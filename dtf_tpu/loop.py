@@ -16,10 +16,9 @@ from __future__ import annotations
 import logging
 from typing import Any, Callable, Iterable, Sequence
 
-import jax
-
 from dtf_tpu.checkpoint import Checkpointer
 from dtf_tpu.core.comms import shard_batch
+from dtf_tpu.data.prefetch import prefetch_to_device
 from dtf_tpu.hooks import Hook, StopTraining
 
 PyTree = Any
@@ -43,6 +42,7 @@ class Trainer:
         *,
         checkpointer: Checkpointer | None = None,
         place_batch: Callable | None = None,
+        prefetch: int = 2,
     ):
         self.train_step = train_step
         self.mesh = mesh
@@ -50,6 +50,9 @@ class Trainer:
         self.checkpointer = checkpointer
         self.place_batch = place_batch or (
             lambda batch: shard_batch(batch, self.mesh))
+        # device-side double buffering: batch N+1's H2D transfer dispatches
+        # while step N computes (dtf_tpu/data/prefetch.py). 1 = off.
+        self.prefetch = prefetch
 
     def fit(self, state: PyTree, batches: Iterable[PyTree],
             *, max_steps: int | None = None) -> PyTree:
@@ -66,14 +69,21 @@ class Trainer:
 
         for h in self.hooks:
             h.begin(state)
+        staged = prefetch_to_device(batches, self.place_batch,
+                                    max(self.prefetch, 1))
+        # a resumed run that is already at/past max_steps must be a strict
+        # no-op — pulling even one batch from the (possibly shared,
+        # possibly expensive) iterator would leak it into the void.
+        if max_steps is not None and int(state.step) >= max_steps:
+            staged = ()
         try:
-            for batch in batches:
+            for batch in staged:
                 step = int(state.step)
                 if max_steps is not None and step >= max_steps:
                     break
                 for h in self.hooks:
                     h.before_step(step)
-                state, metrics = self.train_step(state, self.place_batch(batch))
+                state, metrics = self.train_step(state, batch)
                 step += 1
                 for h in self.hooks:
                     h.after_step(step, state, metrics)

@@ -31,18 +31,6 @@ from dtf_tpu.ops import flash_attention as fa
 from dtf_tpu.ops.losses import softmax_cross_entropy
 
 
-def _flash_sharded(q, k, v, pad_mask, mesh: Optional[Mesh], interpret: bool):
-    """Per-shard masked flash kernel over (data, model) — see
-    dtf_tpu/models/gpt.py::_flash_sharded for why the shard_map boundary is
-    where the parallelism lives (Pallas calls aren't GSPMD-partitionable)."""
-    fn = lambda q, k, v, m: fa.flash_attention(  # noqa: E731
-        q, k, v, kv_mask=m, interpret=interpret)
-    if mesh is None:
-        return fn(q, k, v, pad_mask)
-    spec = P("data", "model", None, None)
-    return jax.shard_map(fn, mesh=mesh,
-                         in_specs=(spec, spec, spec, P("data", None)),
-                         out_specs=spec, check_vma=False)(q, k, v, pad_mask)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,10 +99,10 @@ class SelfAttention(nn.Module):
                 impl = "flash" if jax.default_backend() == "tpu" else "dense"
             if impl == "flash":
                 # fused kernel with the padding mask riding as a -inf bias
-                # row (flash_attention kv_mask); sharded like GPT's path —
-                # batch over data, heads over model, seq whole per shard.
-                out = _flash_sharded(q, k, v, pad_mask, self.mesh,
-                                     interpret=jax.default_backend() != "tpu")
+                # row; batch over data, heads over model, seq whole/shard.
+                out = fa.flash_attention_sharded(
+                    q, k, v, self.mesh, kv_mask=pad_mask,
+                    interpret=jax.default_backend() != "tpu")
             elif impl == "dense":
                 bias = jnp.where(pad_mask[:, None, None, :], 0.0, -jnp.inf)
                 out = att.dense_attention(q, k, v, bias=bias)

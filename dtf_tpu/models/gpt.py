@@ -25,7 +25,6 @@ head, bf16 compute / f32 params.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Optional
 
 import jax
@@ -112,20 +111,6 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     y2 = x1 * sin + x2 * cos
     out = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
     return out.astype(x.dtype)
-
-
-def _flash_sharded(q, k, v, mesh: Optional[Mesh], interpret: bool):
-    """Per-shard flash kernel over (data, model): batch/head dims are
-    partitioned, seq stays whole. Pallas calls can't be GSPMD-partitioned
-    from outside, so the shard_map boundary is where the parallelism lives."""
-    if mesh is None:
-        return fa.flash_attention(q, k, v, causal=True, interpret=interpret)
-    fn = partial(fa.flash_attention, causal=True, interpret=interpret)
-    spec = P("data", "model", None, None)
-    # check_vma=False: pallas_call out_shapes carry no varying-manual-axes
-    # info, so shard_map's vma checker can't type them.
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec, check_vma=False)(q, k, v)
 
 
 class CausalSelfAttention(nn.Module):
@@ -236,8 +221,9 @@ class CausalSelfAttention(nn.Module):
         elif impl == "ring":
             out = att.ring_attention_sharded(q, k, v, self.mesh, causal=True)
         elif impl == "flash":
-            out = _flash_sharded(q, k, v, self.mesh,
-                                 interpret=jax.default_backend() != "tpu")
+            out = fa.flash_attention_sharded(
+                q, k, v, self.mesh, causal=True,
+                interpret=jax.default_backend() != "tpu")
         else:
             out = att.dense_attention(q, k, v, causal=True)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], t, cfg.d_model)

@@ -415,6 +415,39 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
+                            kv_mask: Optional[jax.Array] = None,
+                            interpret: bool = False) -> jax.Array:
+    """Per-shard flash kernel over a (data, model) mesh: batch/head dims are
+    partitioned, seq stays whole per shard. Pallas calls can't be
+    GSPMD-partitioned from outside, so the shard_map boundary is where the
+    parallelism lives. ``mesh=None`` falls through to the plain kernel.
+    Shared by the GPT (causal) and BERT (kv_mask) model paths.
+
+    check_vma=False: pallas_call out_shapes carry no varying-manual-axes
+    info, so shard_map's vma checker can't type them.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if mesh is None:
+        return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
+                               interpret=interpret)
+    spec = P("data", "model", None, None)
+    if kv_mask is None:
+        fn = functools.partial(flash_attention, causal=causal,
+                               interpret=interpret)
+        return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+
+    def fn(q, k, v, m):
+        return flash_attention(q, k, v, causal=causal, kv_mask=m,
+                               interpret=interpret)
+
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec, P("data", None)),
+        out_specs=spec, check_vma=False)(q, k, v, kv_mask)
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
                     kv_mask: Optional[jax.Array] = None,

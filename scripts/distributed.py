@@ -55,6 +55,7 @@ def main(argv):
     model = mnist_model.make_model(FLAGS.model)
     # GradientDescentOptimizer equivalent; the reference used plain SGD.
     tx = optax.sgd(FLAGS.learning_rate)
+    tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         mnist_model.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed),
         mesh)

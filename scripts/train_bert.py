@@ -59,6 +59,7 @@ def main(argv):
             0.0, FLAGS.learning_rate,
             min(1000, FLAGS.train_steps // 10 + 1), FLAGS.train_steps),
         weight_decay=0.01)
+    tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=bert.tp_rules, zero1=FLAGS.zero1)

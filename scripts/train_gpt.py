@@ -73,6 +73,7 @@ def main(argv):
             0.0, FLAGS.learning_rate,
             min(1000, FLAGS.train_steps // 10 + 1), FLAGS.train_steps),
         weight_decay=0.1)
+    tx = dflags.wrap_optimizer(tx, FLAGS)
     pipelined = mesh.shape.get("pipe", 1) > 1
     if pipelined:
         from dtf_tpu.models import gpt_pipe

@@ -58,6 +58,7 @@ def main(argv):
         0.0, FLAGS.learning_rate, min(500, steps_total // 10 + 1),
         steps_total)
     tx = optax.sgd(sched, momentum=0.9, nesterov=True)
+    tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         resnet.make_init(model, shape), tx, jax.random.PRNGKey(FLAGS.seed),
         mesh)

@@ -42,6 +42,7 @@ def main(argv):
     model = widedeep.WideDeep(hash_buckets=FLAGS.hash_buckets,
                               embed_dim=FLAGS.embed_dim)
     tx = optax.adam(FLAGS.learning_rate)
+    tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         widedeep.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=widedeep.rules)

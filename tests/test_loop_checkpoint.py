@@ -37,6 +37,37 @@ def test_trainer_runs_and_stops(mesh8, tmp_path):
     assert int(state.step) == 7
 
 
+def test_prefetch_iterator_order_and_exactly_once():
+    from dtf_tpu.data.prefetch import prefetch_to_device
+
+    placed = []
+    got = list(prefetch_to_device(range(7), lambda x: (placed.append(x), x)[1],
+                                  depth=3))
+    assert got == list(range(7))
+    assert placed == list(range(7))
+    assert list(prefetch_to_device(range(3), lambda x: x, depth=1)) == [0, 1, 2]
+    with pytest.raises(ValueError, match="depth"):
+        next(prefetch_to_device(range(3), lambda x: x, depth=0))
+
+
+def test_trainer_prefetch_same_losses(mesh8):
+    """Device prefetch is a latency optimization only: identical metrics."""
+    def run(prefetch):
+        state, step = build(mesh8)
+        losses = []
+
+        class Grab(StopAtStepHook):
+            def after_step(self, s, st, metrics):
+                losses.append(float(metrics["loss"]))
+                super().after_step(s, st, metrics)
+
+        Trainer(step, mesh8, hooks=[Grab(5)],
+                prefetch=prefetch).fit(state, batches(100))
+        return losses
+
+    np.testing.assert_array_equal(run(1), run(3))
+
+
 def test_checkpoint_roundtrip(mesh8, tmp_path):
     state, step = build(mesh8)
     ckpt = Checkpointer(tmp_path / "ckpt", async_save=False)

@@ -107,3 +107,23 @@ def test_metrics_and_extra_passthrough(mesh8):
     state, metrics = step(state, batch)
     assert set(metrics) == {"mse", "loss", "grad_norm"}
     assert metrics["grad_norm"] > 0
+
+
+def test_wrap_optimizer_clips_global_norm():
+    """--clip_grad_norm flag: global-norm clip before the update; 0 = off."""
+    from types import SimpleNamespace
+
+    import optax
+
+    from dtf_tpu.cli.flags import wrap_optimizer
+
+    params = {"w": jnp.zeros(3)}
+    grads = {"w": jnp.asarray([3.0, 4.0, 0.0])}      # global norm 5
+    tx = wrap_optimizer(optax.sgd(1.0), SimpleNamespace(clip_grad_norm=1.0))
+    upd, _ = tx.update(grads, tx.init(params), params)
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(upd["w"])), 1.0, rtol=1e-6)
+    tx0 = wrap_optimizer(optax.sgd(1.0), SimpleNamespace(clip_grad_norm=0.0))
+    upd0, _ = tx0.update(grads, tx0.init(params), params)
+    np.testing.assert_allclose(
+        float(jnp.linalg.norm(upd0["w"])), 5.0, rtol=1e-6)
