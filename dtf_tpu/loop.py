@@ -69,13 +69,20 @@ class Trainer:
 
         for h in self.hooks:
             h.begin(state)
-        staged = prefetch_to_device(batches, self.place_batch,
+        # Bound the source to exactly the steps this call can run, so the
+        # prefetch lookahead can never pull batches past max_steps out of a
+        # (possibly shared) iterator — including the already-done resume
+        # case, which stays a strict no-op. Hook-driven early stops
+        # (StopTraining) can still discard up to depth-1 staged batches;
+        # that lookahead is inherent to prefetching.
+        src = batches
+        if max_steps is not None:
+            import itertools
+
+            src = itertools.islice(
+                batches, max(max_steps - int(state.step), 0))
+        staged = prefetch_to_device(src, self.place_batch,
                                     max(self.prefetch, 1))
-        # a resumed run that is already at/past max_steps must be a strict
-        # no-op — pulling even one batch from the (possibly shared,
-        # possibly expensive) iterator would leak it into the void.
-        if max_steps is not None and int(state.step) >= max_steps:
-            staged = ()
         try:
             for batch in staged:
                 step = int(state.step)

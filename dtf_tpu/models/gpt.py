@@ -326,16 +326,51 @@ def cache_shardings(mesh: Mesh, cache_shapes):
     return jax.tree.map(leaf, cache_shapes)
 
 
+def filter_logits(logits: jax.Array, *, top_k: int = 0,
+                  top_p: float = 1.0) -> jax.Array:
+    """Top-k / nucleus (top-p) filtering: disallowed logits become -inf.
+
+    Static shapes throughout (one sort + thresholds, no gather of a dynamic
+    count), so it jits and vmaps cleanly inside the decode scan. ``top_k=0``
+    and ``top_p=1.0`` are no-ops; the highest-probability token is always
+    kept. k-filter applies first, then the nucleus is computed over the
+    k-survivors (the standard sequential-warper composition). Callers
+    should pass ALREADY-TEMPERED logits (logits/temperature) so the
+    nucleus reflects the distribution actually sampled — ``generate``
+    does.
+    """
+    if top_k <= 0 and top_p >= 1.0:
+        return logits
+    vocab = logits.shape[-1]
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]   # one sort serves both
+    if top_k > 0:
+        k = min(top_k, vocab)
+        logits = jnp.where(logits < desc[..., k - 1][..., None],
+                           -jnp.inf, logits)
+        desc = jnp.where(jnp.arange(vocab) < k, desc, -jnp.inf)
+    if top_p < 1.0:
+        probs = jax.nn.softmax(desc, axis=-1)     # -inf rows contribute 0
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p          # first excluded crosses top_p
+        thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < thresh, -jnp.inf, logits)
+    return logits
+
+
 def generate(model: GPT, params, prompt: jax.Array, n_new: int,
              *, rng: Optional[jax.Array] = None,
              temperature: float = 0.0,
+             top_k: int = 0, top_p: float = 1.0,
              mesh: Optional[Mesh] = None) -> jax.Array:
     """Autoregressive decode with the KV cache, as one ``lax.scan``.
 
     ``model.cfg.decode_len`` must cover prompt+new tokens. ``prompt``
     [B, T_p] int32; returns [B, T_p + n_new]. Greedy when temperature==0,
-    else temperature sampling. The whole loop is jittable: the cache is
-    scan-carried state, one token per step — the standard TPU decode shape.
+    else temperature sampling with optional ``top_k`` / nucleus ``top_p``
+    filtering (:func:`filter_logits`). The whole loop is jittable: the
+    cache is scan-carried state, one token per step — the standard TPU
+    decode shape.
 
     ``mesh``: shard the decode — the KV cache lands P('data','model')
     (batch over data shards, heads over TP shards; see
@@ -392,7 +427,11 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
         nxt_logits = logits[:, 0]
         rng, sub = jax.random.split(rng)
         if temperature > 0.0:
-            nxt = jax.random.categorical(sub, nxt_logits / temperature, -1)
+            # temper FIRST so the nucleus is built from the distribution
+            # actually sampled (the standard warper ordering).
+            filtered = filter_logits(nxt_logits / temperature,
+                                     top_k=top_k, top_p=top_p)
+            nxt = jax.random.categorical(sub, filtered, -1)
         else:
             nxt = jnp.argmax(nxt_logits, -1)
         nxt = nxt.astype(jnp.int32)

@@ -238,6 +238,37 @@ def test_generate_sharded_matches_single_device():
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_filter_logits_top_k_and_top_p():
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.15, 0.1]]))
+    k2 = gpt.filter_logits(logits, top_k=2)
+    assert np.isfinite(np.asarray(k2[0, :2])).all()
+    assert np.isneginf(np.asarray(k2[0, 2:])).all()
+    # nucleus 0.7: 0.5 kept, 0.25 kept (cum-before 0.5 < 0.7), 0.15 cut
+    p = gpt.filter_logits(logits, top_p=0.7)
+    assert np.isfinite(np.asarray(p[0, :2])).all()
+    assert np.isneginf(np.asarray(p[0, 2:])).all()
+    # the top token always survives even with tiny top_p
+    tiny = gpt.filter_logits(logits, top_p=1e-9)
+    assert np.isfinite(tiny[0, 0]) and np.isneginf(np.asarray(tiny[0, 1:])).all()
+    # no-ops leave logits untouched
+    np.testing.assert_array_equal(np.asarray(gpt.filter_logits(logits)),
+                                  np.asarray(logits))
+
+
+def test_generate_top_k1_equals_greedy():
+    """Sampling at any temperature with top_k=1 collapses to greedy."""
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=24)
+    model = gpt.GPT(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32))
+    prompt = jnp.asarray(data_batch(n=2)["input_ids"][:, :8])
+    greedy = gpt.generate(model, variables["params"], prompt, 8)
+    sampled = gpt.generate(model, variables["params"], prompt, 8,
+                           temperature=1.7, top_k=1,
+                           rng=jax.random.PRNGKey(42))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
 def test_gpt_gqa_learns_and_cache_is_smaller(mesh8):
     """GQA (kv_heads < heads): trains, and the KV cache actually shrinks by
     the group factor — the decode-memory win GQA exists for."""

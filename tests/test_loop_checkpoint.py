@@ -50,6 +50,29 @@ def test_prefetch_iterator_order_and_exactly_once():
         next(prefetch_to_device(range(3), lambda x: x, depth=0))
 
 
+def test_trainer_max_steps_consumes_exactly_that_many_batches(mesh8):
+    """Prefetch lookahead must not pull past max_steps from a shared
+    iterator: two sequential fits on one iterator see disjoint batches."""
+    state, step = build(mesh8)
+    pulled = []
+
+    def counting():
+        for i in range(100):
+            pulled.append(i)
+            yield make_batch(seed=i)
+
+    it = counting()
+    state = Trainer(step, mesh8, prefetch=3).fit(state, it, max_steps=4)
+    assert int(state.step) == 4
+    assert pulled == [0, 1, 2, 3]          # not 4+lookahead
+    state = Trainer(step, mesh8, prefetch=3).fit(state, it, max_steps=6)
+    assert int(state.step) == 6
+    assert pulled == [0, 1, 2, 3, 4, 5]    # continues exactly where left
+    # already-done resume: strict no-op
+    Trainer(step, mesh8, prefetch=3).fit(state, it, max_steps=6)
+    assert pulled == [0, 1, 2, 3, 4, 5]
+
+
 def test_trainer_prefetch_same_losses(mesh8):
     """Device prefetch is a latency optimization only: identical metrics."""
     def run(prefetch):
