@@ -67,6 +67,29 @@ class Checkpointer:
             if isinstance(x, jax.Array) else x, target)
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
+    def restore_raw(self, step: int | None = None) -> PyTree:
+        """Restore exactly as saved, no target tree required.
+
+        The serving-side entry: a decode process wants the params out of a
+        training checkpoint without reconstructing the optimizer (whose
+        state shapes it can't know). StandardSave'd pytrees come back as
+        nested dicts — a saved TrainState yields keys ``params`` /
+        ``opt_state`` / ``step`` / ``extra`` / ``rng``.
+
+        Known cost: the FULL saved tree is read (opt-state included, ~3x
+        params bytes for Adam) — Orbax's Standard handler, which our saves
+        use, pairs only with StandardRestore and has no partial-subtree
+        restore (PyTreeRestore(partial_restore=True) raises a
+        handler-mismatch ValueError against StandardSave'd checkpoints).
+        A one-time startup cost for a serving process; revisit if Orbax
+        grows partial StandardRestore.
+        """
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        return self._mgr.restore(step)
+
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:
         """(state, restored_step) — state unchanged if nothing on disk."""
         step = self._mgr.latest_step()

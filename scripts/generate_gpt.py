@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""GPT inference: load a train_gpt.py checkpoint, decode with the KV cache.
+
+    python scripts/generate_gpt.py --logdir=/tmp/dtf_tpu_logs --size=tiny \
+        --prompt=12,7,99 --n_new=16 --temperature=0.8 --top_p=0.9
+
+The serving half of the flagship loop: restores params from the Orbax
+checkpoint the training launcher wrote, builds the decode-mode model
+(``decode_len`` sized to prompt+new), and runs :func:`dtf_tpu.models.gpt.
+generate` — greedy or temperature/top-k/nucleus sampling, optionally
+sharded over a (data, model) mesh (KV cache lands P('data','model')).
+Prints one token-id row per batch element.
+"""
+
+import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from dtf_tpu.cli import flags as dflags
+
+dflags.define_cluster_flags()
+dflags.define_mesh_flags()
+flags.DEFINE_string("logdir", "/tmp/dtf_tpu_logs", "training logdir whose "
+                    "ckpt/ subdir holds the checkpoint to serve")
+flags.DEFINE_string("size", "small", "small (gpt2-124M) | tiny — must match "
+                    "the trained config")
+flags.DEFINE_integer("kv_heads", 0, "grouped-query attention heads; must "
+                     "match the trained config (0 = plain MHA)")
+flags.DEFINE_string("prompt", "", "comma-separated token ids; empty = a "
+                    "fixed demo prompt")
+flags.DEFINE_integer("batch", 1, "decode batch size (prompt is broadcast)")
+flags.DEFINE_integer("n_new", 32, "tokens to generate")
+flags.DEFINE_float("temperature", 0.0, "0 = greedy, else sampling")
+flags.DEFINE_integer("top_k", 0, "top-k filter (0 = off)")
+flags.DEFINE_float("top_p", 1.0, "nucleus filter (1.0 = off)")
+flags.DEFINE_integer("seed", 0, "sampling PRNG seed")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dtf_tpu.checkpoint import Checkpointer
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.core.sharding import shard_tree
+    from dtf_tpu.models import gpt
+
+    if FLAGS.temperature == 0.0 and (FLAGS.top_k or FLAGS.top_p < 1.0):
+        raise app.UsageError(
+            "--top_k/--top_p have no effect at --temperature=0 (greedy); "
+            "set a positive temperature to sample")
+    if FLAGS.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    # Serving is a single-process, chief-only job: no cluster bootstrap.
+    # Sharded decode is opt-in (explicit positive mesh axes) and runs on a
+    # device SUBSET sized to the mesh — a serving batch is often tiny, and
+    # training's all-devices mesh would demand batch % n_devices == 0.
+    sharded = FLAGS.mesh_model > 1 or FLAGS.mesh_data > 1
+    mesh = None
+    if sharded:
+        dp = max(FLAGS.mesh_data, 1)
+        tp = max(FLAGS.mesh_model, 1)
+        if dp * tp > len(jax.devices()):
+            raise app.UsageError(
+                f"mesh {dp}x{tp} exceeds {len(jax.devices())} devices")
+        mesh = make_mesh(MeshConfig(data=dp, model=tp),
+                         devices=jax.devices()[:dp * tp])
+
+    base = (gpt.GPTConfig.gpt2_small() if FLAGS.size == "small"
+            else gpt.GPTConfig.tiny())
+    prompt_ids = ([int(t) for t in FLAGS.prompt.split(",") if t.strip()]
+                  or [1, 2, 3, 4])
+    if max(prompt_ids) >= base.vocab_size or min(prompt_ids) < 0:
+        raise app.UsageError(
+            f"prompt ids must be in [0, {base.vocab_size})")
+    total = len(prompt_ids) + FLAGS.n_new
+    cfg = dataclasses.replace(base, kv_heads=FLAGS.kv_heads or None,
+                              decode_len=total)
+    model = gpt.GPT(cfg)
+
+    ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"))
+    step = ckpt.latest_step()
+    if step is None:
+        raise app.UsageError(f"no checkpoint under {FLAGS.logdir}/ckpt")
+    # raw restore: pull params out of the saved TrainState without
+    # reconstructing the optimizer state's shapes
+    params = ckpt.restore_raw(step)["params"]
+    print(f"restored checkpoint step {step} from {FLAGS.logdir}/ckpt",
+          file=sys.stderr)
+
+    if sharded:
+        params = shard_tree(params, mesh, gpt.tp_rules)
+
+    prompt = jnp.broadcast_to(jnp.asarray(prompt_ids, jnp.int32)[None, :],
+                              (FLAGS.batch, len(prompt_ids)))
+    out = gpt.generate(model, params, prompt, FLAGS.n_new,
+                       rng=jax.random.PRNGKey(FLAGS.seed),
+                       temperature=FLAGS.temperature,
+                       top_k=FLAGS.top_k, top_p=FLAGS.top_p, mesh=mesh)
+    for row in np.asarray(out):
+        print(",".join(str(int(t)) for t in row))
+
+
+if __name__ == "__main__":
+    app.run(main)

@@ -136,6 +136,23 @@ def test_crash_recovery_matches_uninterrupted(mesh8, tmp_path):
         straight.params, resumed.params)
 
 
+def test_restore_raw_layout_and_missing(mesh8, tmp_path):
+    """restore_raw: target-free restore comes back as nested dicts with the
+    TrainState's keys (the serving contract generate_gpt.py relies on)."""
+    state, step = build(mesh8)
+    state, _ = step(state, make_batch(seed=0))
+    ckpt = Checkpointer(tmp_path / "raw")
+    ckpt.save(1, state, force=True)
+    ckpt.wait()
+    raw = ckpt.restore_raw()
+    assert set(raw) >= {"params", "opt_state", "step"}
+    np.testing.assert_array_equal(
+        np.asarray(raw["params"]["w"]), np.asarray(state.params["w"]))
+    assert int(raw["step"]) == int(state.step)
+    with pytest.raises(FileNotFoundError):
+        Checkpointer(tmp_path / "empty").restore_raw()
+
+
 def test_restore_missing_raises(mesh8, tmp_path):
     state, _ = build(mesh8)
     ckpt = Checkpointer(tmp_path / "empty")
