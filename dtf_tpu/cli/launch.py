@@ -54,6 +54,40 @@ def setup(FLAGS):
     return mesh, info
 
 
+def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,
+                 *, kind, mode, vocab_size, batch_shardings=None):
+    """EvalHook for the LM launchers — the one copy of the eval policy.
+
+    Held-out source: ``<data_dir>/val.bin`` when present, else a synthetic
+    stream at seed+1 (disjoint from training's seed). Sweep = 4 batches.
+    ``batch_shardings`` must be the same override the train step uses when
+    sequence parallelism places batches P('data','seq').
+    """
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.data import formats
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.hooks import EvalHook
+
+    eval_data = formats.detect_token_data(
+        FLAGS.data_dir, FLAGS.batch_size, FLAGS.seq_len, mode=mode,
+        vocab_size=vocab_size, seed=FLAGS.seed, split="val",
+        host_index=info.process_id, host_count=info.num_processes)
+    if eval_data is not None:
+        batches_fn = lambda: (eval_data.batch(i) for i in range(4))  # noqa: E731,E501
+    else:
+        held_out = SyntheticData(
+            kind, FLAGS.batch_size, seed=FLAGS.seed + 1,
+            seq_len=FLAGS.seq_len, vocab_size=vocab_size,
+            host_index=info.process_id, host_count=info.num_processes)
+        batches_fn = lambda: (held_out.batch(10_000_000 + i)  # noqa: E731
+                              for i in range(4))
+    step = tr.make_eval_step(eval_fn, mesh, shardings,
+                             batch_shardings=batch_shardings)
+    return EvalHook(step, batches_fn, writer,
+                    FLAGS.eval_every or FLAGS.train_steps,
+                    place_batch=place_batch)
+
+
 def profiler_hooks(FLAGS):
     """[ProfilerHook] from ``--profile_steps``/``--profile_start``, or []."""
     if not getattr(FLAGS, "profile_steps", 0):

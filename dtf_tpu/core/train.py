@@ -266,14 +266,21 @@ def make_train_step(
     )
 
 
-def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState):
-    """Compiled eval step: ``eval_fn(params, extra, batch) -> metrics dict``."""
+def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState, *,
+                   batch_shardings: PyTree | None = None):
+    """Compiled eval step: ``eval_fn(params, extra, batch) -> metrics dict``.
+
+    ``batch_shardings``: override the default data-axis batch placement —
+    REQUIRED under sequence parallelism (P('data','seq') batches), exactly
+    like ``make_train_step``'s parameter of the same name; a committed
+    input whose sharding disagrees with in_shardings makes jit raise.
+    """
 
     def step_fn(state: TrainState, batch: PyTree):
         return eval_fn(state.params, state.extra, batch)
 
     return jax.jit(
         step_fn,
-        in_shardings=(shardings, batch_sharding(mesh)),
+        in_shardings=(shardings, batch_shardings or batch_sharding(mesh)),
         out_shardings=NamedSharding(mesh, P()),
     )

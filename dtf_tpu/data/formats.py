@@ -130,10 +130,10 @@ class TokenBinData:
 
     def __init__(self, path: str, batch_size: int, seq_len: int, *,
                  mode: str = "clm", vocab_size: int = 0,
-                 mask_token: int = 103, seed: int = 0,
+                 mask_token: int = 103, seed: int = 0, split: str = "train",
                  host_index: int = 0, host_count: int = 1):
         if os.path.isdir(path):
-            path = os.path.join(path, "train.bin")
+            path = os.path.join(path, f"{split}.bin")
         dtype = np.uint32 if vocab_size > 65535 else np.uint16
         self.tokens = np.memmap(path, dtype=dtype, mode="r")
         if len(self.tokens) < seq_len + 1:
@@ -172,9 +172,12 @@ class TokenBinData:
         self.host = host_index
 
     @staticmethod
-    def available(path: str) -> bool:
-        return (os.path.exists(path) and path.endswith(".bin")) or \
-            os.path.exists(os.path.join(path, "train.bin"))
+    def available(path: str, split: str = "train") -> bool:
+        """True when ``path`` holds this split: ``<path>/<split>.bin``, or a
+        direct ``.bin`` file (train split only)."""
+        return (split == "train" and os.path.isfile(path)
+                and path.endswith(".bin")) or \
+            os.path.exists(os.path.join(path, f"{split}.bin"))
 
     def batch(self, step: int) -> Batch:
         r = np.random.default_rng(
@@ -447,12 +450,28 @@ def detect_image_eval_data(data_dir: str, batch_size: int,
 
 
 def detect_token_data(data_dir: str, batch_size: int, seq_len: int, *,
-                      mode: str, vocab_size: int = 0,
+                      mode: str, vocab_size: int = 0, split: str = "train",
                       **kw) -> Optional[object]:
-    if data_dir and TokenBinData.available(data_dir):
+    """``<dir>/<split>.bin`` (nanoGPT convention: train.bin / val.bin), or a
+    direct ``.bin`` path for the train split. None when the split is
+    absent — callers then fall back (synthetic, or skip eval). A PRESENT
+    but unusable non-train split (too short for seq_len, empty file) also
+    falls back with a warning instead of killing a run whose training data
+    is fine; the train split still fails loudly."""
+    if not data_dir or not TokenBinData.available(data_dir, split):
+        return None
+    try:
         return TokenBinData(data_dir, batch_size, seq_len, mode=mode,
-                            vocab_size=vocab_size, **kw)
-    return None
+                            vocab_size=vocab_size, split=split, **kw)
+    except ValueError:
+        if split == "train":
+            raise
+        import logging
+
+        logging.getLogger("dtf_tpu").warning(
+            "%s/%s.bin exists but is unusable (too short for seq_len=%d?); "
+            "falling back", data_dir, split, seq_len, exc_info=True)
+        return None
 
 
 def detect_criteo_data(data_dir: str, batch_size: int,

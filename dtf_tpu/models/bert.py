@@ -193,6 +193,20 @@ def make_init(cfg: BertConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
     return model, init_fn
 
 
+def make_eval(model: BertMLM):
+    """Held-out MLM eval: mean CE over masked positions + perplexity."""
+
+    def eval_fn(params, extra, batch):
+        logits = model.apply(
+            {"params": params}, batch["input_ids"], batch["segment_ids"],
+            batch["attention_mask"].astype(bool), deterministic=True)
+        loss, _ = softmax_cross_entropy(logits, batch["mlm_labels"],
+                                        ignore_index=-100)
+        return {"eval_mlm_loss": loss, "eval_mlm_ppl": jnp.exp(loss)}
+
+    return eval_fn
+
+
 def make_loss(model: BertMLM):
     """MLM loss: CE over masked positions (labels==-100 elsewhere)."""
 

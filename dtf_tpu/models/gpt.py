@@ -450,6 +450,22 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     return out
 
 
+def make_eval(model: GPT):
+    """Held-out eval: mean next-token CE and perplexity (ignore -100)."""
+
+    def eval_fn(params, extra, batch):
+        cfg = model.cfg
+        out = model.apply({"params": params}, batch["input_ids"],
+                          deterministic=True,
+                          mutable=["losses"] if cfg.moe_every else False)
+        logits = out[0] if cfg.moe_every else out
+        loss, _ = softmax_cross_entropy(logits, batch["labels"],
+                                        ignore_index=-100)
+        return {"eval_loss": loss, "eval_ppl": jnp.exp(loss)}
+
+    return eval_fn
+
+
 def make_loss(model: GPT):
     """Next-token CE: batch = {"input_ids" [B,T], "labels" [B,T]} where
     labels are input_ids shifted left by the data layer (-100 = ignore)."""

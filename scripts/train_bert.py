@@ -26,6 +26,8 @@ flags.DEFINE_string("size", "base", "base | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
 flags.DEFINE_string("attn_impl", "auto", "auto (flash on TPU) | dense | "
                     "flash — non-seq-sharded attention backend")
+flags.DEFINE_integer("eval_every", 0, "held-out MLM eval (val.bin or "
+                     "held-out synthetic) every N steps; 0 = final only")
 FLAGS = flags.FLAGS
 
 
@@ -36,7 +38,7 @@ def main(argv):
     from jax.sharding import PartitionSpec as P
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import profiler_hooks, setup
+    from dtf_tpu.cli.launch import (lm_eval_hook, profiler_hooks, setup)
     from dtf_tpu.core import train as tr
     from dtf_tpu.core.comms import batch_shardings_for
     from dtf_tpu.data.synthetic import SyntheticData
@@ -92,15 +94,21 @@ def main(argv):
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
+    place_batch = lambda b: shard_batch(b, mesh, spec=spec)  # noqa: E731
+    eval_hook = lm_eval_hook(
+        FLAGS, info, mesh, shardings, bert.make_eval(model), writer,
+        place_batch, kind="bert", mode="mlm", vocab_size=cfg.vocab_size,
+        batch_shardings=kwargs.get("batch_shardings"))
     trainer = Trainer(
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
+               eval_hook,
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt,
-        place_batch=lambda b: shard_batch(b, mesh, spec=spec))
+        place_batch=place_batch)
     state = trainer.fit(state, iter(data))
     writer.close()
     ckpt.close()

@@ -38,6 +38,9 @@ flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
                      "default)")
 flags.DEFINE_integer("pipe_interleave", 1, "model chunks per pipe device "
                      "(Megatron interleaved schedule when >1)")
+flags.DEFINE_integer("eval_every", 0, "held-out eval (val.bin or held-out "
+                     "synthetic) every N steps; 0 = final eval only. "
+                     "Skipped on the pipelined path.")
 FLAGS = flags.FLAGS
 
 
@@ -48,7 +51,7 @@ def main(argv):
     from jax.sharding import PartitionSpec as P
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import profiler_hooks, setup
+    from dtf_tpu.cli.launch import (lm_eval_hook, profiler_hooks, setup)
     from dtf_tpu.core import train as tr
     from dtf_tpu.core.comms import batch_shardings_for, shard_batch
     from dtf_tpu.data.synthetic import SyntheticData
@@ -160,18 +163,26 @@ def main(argv):
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
+    place_batch = lambda b: shard_batch(  # noqa: E731
+        gpt.zigzag_batch(b, mesh.shape["seq"])
+        if (sp and FLAGS.attn_impl == "zigzag") else b,
+        mesh, spec=spec)
+    eval_hook = None
+    if model is not None:  # pipelined path has no plain-model eval fn
+        eval_hook = lm_eval_hook(
+            FLAGS, info, mesh, shardings, gpt.make_eval(model), writer,
+            place_batch, kind="gpt", mode="clm", vocab_size=cfg.vocab_size,
+            batch_shardings=kwargs.get("batch_shardings"))
     trainer = Trainer(
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
+               *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt,
-        place_batch=lambda b: shard_batch(
-            gpt.zigzag_batch(b, mesh.shape["seq"])
-            if (sp and FLAGS.attn_impl == "zigzag") else b,
-            mesh, spec=spec))
+        place_batch=place_batch)
     state = trainer.fit(state, iter(data))
     writer.close()
     ckpt.close()

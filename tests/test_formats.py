@@ -111,6 +111,41 @@ def test_token_bin_clm_windows(tmp_path):
     assert not np.array_equal(data.batch(1)["input_ids"], b["input_ids"])
 
 
+def test_detect_token_data_splits(tmp_path):
+    """val.bin is detected only via split='val'; absent splits return None
+    (the eval-hook fallback contract), and the two splits read their own
+    files."""
+    (tmp_path / "train.bin").write_bytes(
+        (np.arange(1000, dtype=np.uint16) % 7).tobytes())
+    (tmp_path / "val.bin").write_bytes(
+        (np.full(1000, 9, dtype=np.uint16)).tobytes())
+    train = formats.detect_token_data(str(tmp_path), 4, 16, mode="clm")
+    val = formats.detect_token_data(str(tmp_path), 4, 16, mode="clm",
+                                    split="val")
+    assert train is not None and val is not None
+    assert int(train.batch(0)["input_ids"].max()) < 7
+    assert (val.batch(0)["input_ids"] == 9).all()
+    assert formats.detect_token_data(str(tmp_path), 4, 16, mode="clm",
+                                     split="test") is None
+    # direct .bin path still works for the train split only
+    assert formats.detect_token_data(
+        str(tmp_path / "train.bin"), 4, 16, mode="clm") is not None
+    assert formats.detect_token_data(
+        str(tmp_path / "train.bin"), 4, 16, mode="clm", split="val") is None
+    # a present-but-too-short val.bin falls back (None) instead of raising;
+    # a too-short TRAIN split still fails loudly
+    (tmp_path / "val.bin").write_bytes(
+        np.arange(4, dtype=np.uint16).tobytes())
+    assert formats.detect_token_data(str(tmp_path), 4, 16, mode="clm",
+                                     split="val") is None
+    import pytest as _pytest
+    (tmp_path / "short" ).mkdir()
+    (tmp_path / "short" / "train.bin").write_bytes(
+        np.arange(4, dtype=np.uint16).tobytes())
+    with _pytest.raises(ValueError):
+        formats.detect_token_data(str(tmp_path / "short"), 4, 16, mode="clm")
+
+
 def test_token_bin_uint32_when_large_vocab(tmp_path):
     toks = np.array([0, 70000, 1, 70001] * 50, dtype=np.uint32)
     (tmp_path / "train.bin").write_bytes(toks.tobytes())
