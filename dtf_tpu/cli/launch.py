@@ -58,10 +58,14 @@ def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,
                  *, kind, mode, vocab_size, batch_shardings=None):
     """EvalHook for the LM launchers — the one copy of the eval policy.
 
-    Held-out source: ``<data_dir>/val.bin`` when present, else a synthetic
-    stream at seed+1 (disjoint from training's seed). Sweep = 4 batches.
-    ``batch_shardings`` must be the same override the train step uses when
-    sequence parallelism places batches P('data','seq').
+    Held-out source: ``<data_dir>/val.bin`` when present; a synthetic
+    stream at seed+1 ONLY when training itself is synthetic. Training on
+    real tokens with no val split returns None (skip eval) with a warning —
+    scoring a real model on unrelated synthetic data would masquerade as
+    held-out perplexity (same policy as the image path's
+    ``detect_image_eval_data``). Sweep = 4 batches. ``batch_shardings``
+    must be the same override the train step uses when sequence
+    parallelism places batches P('data','seq').
     """
     from dtf_tpu.core import train as tr
     from dtf_tpu.data import formats
@@ -75,6 +79,12 @@ def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,
     if eval_data is not None:
         batches_fn = lambda: (eval_data.batch(i) for i in range(4))  # noqa: E731,E501
     else:
+        from dtf_tpu.data.formats import TokenBinData
+
+        if FLAGS.data_dir and TokenBinData.available(FLAGS.data_dir):
+            log.warning("no val.bin in %s; skipping held-out eval rather "
+                        "than scoring on synthetic data", FLAGS.data_dir)
+            return None
         held_out = SyntheticData(
             kind, FLAGS.batch_size, seed=FLAGS.seed + 1,
             seq_len=FLAGS.seq_len, vocab_size=vocab_size,

@@ -281,6 +281,11 @@ def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState, *,
 
     return jax.jit(
         step_fn,
-        in_shardings=(shardings, batch_shardings or batch_sharding(mesh)),
+        # `is not None`, not truthiness: a falsy-but-valid shardings pytree
+        # must not silently degrade to the default placement (same rule as
+        # make_train_step's parameter of this name).
+        in_shardings=(shardings,
+                      batch_shardings if batch_shardings is not None
+                      else batch_sharding(mesh)),
         out_shardings=NamedSharding(mesh, P()),
     )

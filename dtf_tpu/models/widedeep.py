@@ -74,6 +74,24 @@ def make_init(model: WideDeep):
     return init_fn
 
 
+def make_eval(model: WideDeep):
+    """Held-out CTR eval: logloss + accuracy + prediction/label correlation
+    (the cheap jittable AUC stand-in the train metrics also use)."""
+
+    def eval_fn(params, extra, batch):
+        logits = model.apply({"params": params}, batch["dense"],
+                             batch["sparse"])
+        loss = optax.sigmoid_binary_cross_entropy(
+            logits, batch["label"]).mean()
+        acc = jnp.mean((logits > 0) == (batch["label"] > 0.5))
+        corr = jnp.nan_to_num(
+            jnp.corrcoef(jax.nn.sigmoid(logits), batch["label"])[0, 1])
+        return {"eval_logloss": loss, "eval_accuracy": acc,
+                "eval_pred_corr": corr}
+
+    return eval_fn
+
+
 def make_loss(model: WideDeep):
     def loss_fn(params, extra, batch, rng):
         logits = model.apply({"params": params}, batch["dense"],

@@ -19,6 +19,8 @@ dflags.define_train_flags(batch_size=512, learning_rate=1e-3,
                           train_steps=300)
 flags.DEFINE_integer("hash_buckets", 100_000, "rows per categorical feature")
 flags.DEFINE_integer("embed_dim", 16, "deep embedding width")
+flags.DEFINE_integer("eval_every", 0, "held-out CTR eval every N steps "
+                     "(0 = final eval only)")
 FLAGS = flags.FLAGS
 
 
@@ -30,8 +32,9 @@ def main(argv):
     from dtf_tpu.checkpoint import Checkpointer
     from dtf_tpu.cli.launch import profiler_hooks, setup
     from dtf_tpu.core import train as tr
+    from dtf_tpu.core.comms import shard_batch
     from dtf_tpu.data.synthetic import SyntheticData
-    from dtf_tpu.hooks import (CheckpointHook, LoggingHook,
+    from dtf_tpu.hooks import (CheckpointHook, EvalHook, LoggingHook,
                                PreemptionHook, StopAtStepHook)
     from dtf_tpu.loop import Trainer
     from dtf_tpu.metrics import MetricWriter
@@ -67,11 +70,31 @@ def main(argv):
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
+    # held-out CTR eval on a disjoint synthetic stream (seed+1) — ONLY when
+    # training itself is synthetic. With a real Criteo dir and no holdout,
+    # skip eval rather than score on unrelated synthetic rows (the
+    # detect_image_eval_data policy).
+    eval_hook = None
+    if isinstance(data, SyntheticData):
+        held_out = SyntheticData("widedeep", FLAGS.batch_size,
+                                 seed=FLAGS.seed + 1,
+                                 hash_buckets=FLAGS.hash_buckets,
+                                 host_index=info.process_id,
+                                 host_count=info.num_processes)
+        eval_hook = EvalHook(
+            tr.make_eval_step(widedeep.make_eval(model), mesh, shardings),
+            lambda: (held_out.batch(10_000_000 + i) for i in range(4)),
+            writer, FLAGS.eval_every or FLAGS.train_steps,
+            place_batch=lambda b: shard_batch(b, mesh))
+    else:
+        absl_logging.warning("real Criteo training data with no holdout "
+                             "split; skipping periodic eval")
     trainer = Trainer(
         step, mesh,
         hooks=[LoggingHook(writer, FLAGS.log_every),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
+               *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt)
