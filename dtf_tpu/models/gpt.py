@@ -57,6 +57,11 @@ class GPTConfig:
     #: attention backend: auto (ring if seq-sharded, flash on tpu, else
     #: dense), or force one of dense|flash|ring.
     attn_impl: str = "auto"
+    #: sliding-window attention: query t sees keys in (t-window, t].
+    #: 0 = full causal. O(T·window) compute on the flash path (out-of-window
+    #: blocks are grid-skipped). Not supported with ring/zigzag seq sharding
+    #: (the ring would still rotate all K/V shards).
+    attn_window: int = 0
     #: every k-th block uses a Switch-MoE FFN (0 = all dense).
     moe_every: int = 0
     moe: moe_lib.MoeConfig = moe_lib.MoeConfig()
@@ -72,6 +77,10 @@ class GPTConfig:
             raise ValueError(
                 f"kv_heads={self.kv_heads} must be >=1 and divide "
                 f"heads={self.heads}")
+        if self.attn_window < 0:
+            # a negative window silently masks EVERY key: all-zero outputs
+            # on the dense path, all--inf softmax (NaN) in decode
+            raise ValueError(f"attn_window={self.attn_window} must be >= 0")
 
     @property
     def kv_heads_resolved(self) -> int:
@@ -173,6 +182,10 @@ class CausalSelfAttention(nn.Module):
                     cv.value, v.astype(cfg.dtype), idx, axis=2)
                 ci.value = idx + 1
             valid = jnp.arange(cfg.decode_len) <= idx           # [L]
+            if cfg.attn_window:
+                # windowed decode: only the last `window` cached positions
+                valid = jnp.logical_and(
+                    valid, jnp.arange(cfg.decode_len) > idx - cfg.attn_window)
             bias = jnp.where(valid, 0.0, -jnp.inf)               # [L]
             # Grouped attention straight against the un-expanded cache:
             # materializing expand_kv(cache) would re-read group x the cache
@@ -213,19 +226,29 @@ class CausalSelfAttention(nn.Module):
         # transient — the cache/params only ever hold kv_heads.
         k, v = expand_kv(k), expand_kv(v)
 
+        if cfg.attn_window and seq_sharded and impl in ("ring", "zigzag"):
+            # only the actually-sharded ring is incompatible; unsharded
+            # configs fall through to dense which supports windows
+            raise ValueError(
+                f"attn_window={cfg.attn_window} is not supported with "
+                f"seq-sharded attn_impl={impl!r} (the ring rotates ALL K/V "
+                "shards); use flash/dense, or shard long local-attention "
+                "sequences over data instead of seq")
         if impl == "zigzag":
             if seq_sharded:
                 out = att.zigzag_ring_attention_sharded(q, k, v, self.mesh)
             else:
-                out = att.dense_attention(q, k, v, causal=True)
+                out = att.dense_attention(q, k, v, causal=True,
+                                          window=cfg.attn_window)
         elif impl == "ring":
             out = att.ring_attention_sharded(q, k, v, self.mesh, causal=True)
         elif impl == "flash":
             out = fa.flash_attention_sharded(
-                q, k, v, self.mesh, causal=True,
+                q, k, v, self.mesh, causal=True, window=cfg.attn_window,
                 interpret=jax.default_backend() != "tpu")
         else:
-            out = att.dense_attention(q, k, v, causal=True)
+            out = att.dense_attention(q, k, v, causal=True,
+                                      window=cfg.attn_window)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], t, cfg.d_model)
         out = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
                        name="attn_out")(out)

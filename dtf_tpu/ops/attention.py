@@ -24,11 +24,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
+                    window: int = 0,
                     bias: Optional[jax.Array] = None,
                     sm_scale: Optional[float] = None) -> jax.Array:
-    """Reference O(T²) attention. [B,H,T,D] → [B,H,T,D]; f32 softmax."""
+    """Reference O(T²) attention. [B,H,T,D] → [B,H,T,D]; f32 softmax.
+
+    ``window > 0`` (requires ``causal``): sliding-window locality — query t
+    attends keys in (t-window, t]. The parity oracle for the flash
+    kernel's O(T·W) path."""
     *_, t_q, d = q.shape
     t_k = k.shape[-2]
+    if window < 0 or (window and not causal):
+        raise ValueError(
+            f"window={window} must be >= 0 and requires causal=True")
     scale = sm_scale if sm_scale is not None else d ** -0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -37,7 +45,10 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if causal:
         q_pos = jnp.arange(t_q)[:, None]
         k_pos = jnp.arange(t_k)[None, :]
-        scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+        keep = q_pos >= k_pos
+        if window:
+            keep = jnp.logical_and(keep, q_pos - k_pos < window)
+        scores = jnp.where(keep, scores, -jnp.inf)
     # Fully-masked rows (e.g. an all-padding sequence) would softmax over
     # all--inf and yield NaN; force them to 0 output with a grad-safe where
     # (matches the ring path's l=0 handling).

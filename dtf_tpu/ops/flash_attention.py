@@ -66,8 +66,8 @@ def _positions(i, j, block_q, block_k):
     return q_pos, k_pos
 
 
-def _score_mask(s, i, j, *, causal, block_q, block_k, t_k):
-    """-inf out invalid (padded-key / future-key) score entries."""
+def _score_mask(s, i, j, *, causal, block_q, block_k, t_k, window=0):
+    """-inf out invalid (padded-key / future-key / out-of-window) scores."""
     need_k_mask = (t_k % block_k) != 0
     if not (causal or need_k_mask):
         return s
@@ -75,7 +75,24 @@ def _score_mask(s, i, j, *, causal, block_q, block_k, t_k):
     mask = k_pos < t_k
     if causal:
         mask = jnp.logical_and(mask, q_pos >= k_pos)
+        if window:
+            # sliding window: query t sees keys in (t-window, t]
+            mask = jnp.logical_and(mask, q_pos - k_pos < window)
     return jnp.where(mask, s, _NEG_INF)
+
+
+def _block_live(i, j, *, causal, window, block_q, block_k):
+    """Does (q-block i, k-block j) contain ANY unmasked position? The grid
+    skip condition: below-diagonal blocks for causal, plus blocks entirely
+    older than the window — this is what makes windowed attention O(T·W)
+    instead of O(T²/2)."""
+    live = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
+    if causal and window:
+        # newest key in block j must be inside the oldest query's window:
+        # (j+1)*bk - 1 > i*bq - window  ⇔  some (qp, kp) has qp-kp < window
+        live = jnp.logical_and(
+            live, (j + 1) * block_k - 1 > i * block_q - window)
+    return live
 
 
 def _zero_padded_q_rows(p, i, *, block_q, t_q):
@@ -92,8 +109,8 @@ def _zero_padded_q_rows(p, i, *, block_q, t_q):
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_q,
-                block_k, num_k, t_q, t_k, has_mask):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, window,
+                block_q, block_k, num_k, t_q, t_k, has_mask):
     mb_ref = rest[0] if has_mask else None
     o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[1:] if has_mask else rest
     i, j = pl.program_id(1), pl.program_id(2)
@@ -104,7 +121,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_q,
         l_scr[...] = jnp.zeros(l_scr.shape, l_scr.dtype)
         acc_scr[...] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
+    run = _block_live(i, j, causal=causal, window=window,
+                      block_q=block_q, block_k=block_k)
 
     @pl.when(run)
     def _block():
@@ -113,7 +131,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, block_q,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _score_mask(s, i, j, causal=causal, block_q=block_q,
-                        block_k=block_k, t_k=t_k)
+                        block_k=block_k, t_k=t_k, window=window)
         if has_mask:
             # additive key-padding bias row (0 valid / -inf padded): the
             # existing -inf machinery (running max, dead-row guards) then
@@ -163,7 +181,7 @@ def _mask_bias(kv_mask, b, t_k, block_k):
     return _pad(bias.reshape(b, 1, t_k), block_k, axis=2)
 
 
-def _fwd(q, k, v, mask_bias, *, sm_scale, causal, block_q, block_k,
+def _fwd(q, k, v, mask_bias, *, sm_scale, causal, window, block_q, block_k,
          interpret):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
@@ -175,8 +193,9 @@ def _fwd(q, k, v, mask_bias, *, sm_scale, causal, block_q, block_k,
     has_mask = mask_bias is not None
 
     kern = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-        block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k, has_mask=has_mask)
+        _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
+        block_q=block_q, block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k,
+        has_mask=has_mask)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -218,7 +237,8 @@ def _fwd(q, k, v, mask_bias, *, sm_scale, causal, block_q, block_k,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-               sm_scale, causal, block_q, block_k, num_k, t_q, t_k, has_mask):
+               sm_scale, causal, window, block_q, block_k, num_k, t_q, t_k,
+               has_mask):
     mb_ref = rest[0] if has_mask else None
     dq_ref, dq_scr = rest[1:] if has_mask else rest
     i, j = pl.program_id(1), pl.program_id(2)
@@ -227,7 +247,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     def _init():
         dq_scr[...] = jnp.zeros(dq_scr.shape, dq_scr.dtype)
 
-    run = (j * block_k <= i * block_q + block_q - 1) if causal else (j >= 0)
+    run = _block_live(i, j, causal=causal, window=window,
+                      block_q=block_q, block_k=block_k)
 
     @pl.when(run)
     def _block():
@@ -238,7 +259,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _score_mask(s, i, j, causal=causal, block_q=block_q,
-                        block_k=block_k, t_k=t_k)
+                        block_k=block_k, t_k=t_k, window=window)
         if has_mask:
             s = s + mb_ref[0, 0][None, :]
         # a fully-masked VALID q row has lse == -inf; exp(s - lse) would be
@@ -258,7 +279,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                sm_scale, causal, block_q, block_k, num_q, t_q, t_k,
+                sm_scale, causal, window, block_q, block_k, num_q, t_q, t_k,
                 has_mask):
     mb_ref = rest[0] if has_mask else None
     dk_ref, dv_ref, dk_scr, dv_scr = rest[1:] if has_mask else rest
@@ -269,7 +290,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dk_scr[...] = jnp.zeros(dk_scr.shape, dk_scr.dtype)
         dv_scr[...] = jnp.zeros(dv_scr.shape, dv_scr.dtype)
 
-    run = (i * block_q + block_q - 1 >= j * block_k) if causal else (i >= 0)
+    # same tile-liveness predicate as fwd/dq (it is symmetric in the tile)
+    run = _block_live(i, j, causal=causal, window=window,
+                      block_q=block_q, block_k=block_k)
 
     @pl.when(run)
     def _block():
@@ -280,7 +303,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * sm_scale
         s = _score_mask(s, i, j, causal=causal, block_q=block_q,
-                        block_k=block_k, t_k=t_k)
+                        block_k=block_k, t_k=t_k, window=window)
         if has_mask:
             s = s + mb_ref[0, 0][None, :]
         p = jnp.where(jnp.isneginf(lse), 0.0, jnp.exp(s - lse))
@@ -301,8 +324,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, block_q,
-         block_k, interpret):
+def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, window,
+         block_q, block_k, interpret):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     num_q = pl.cdiv(t_q, block_q)
@@ -323,8 +346,8 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, block_q,
 
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-            block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k,
+            _dq_kernel, sm_scale=sm_scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k,
             has_mask=has_mask),
         grid=(bh, num_q, num_k),
         in_specs=[
@@ -344,8 +367,8 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, block_q,
 
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, sm_scale=sm_scale, causal=causal, block_q=block_q,
-            block_k=block_k, num_q=num_q, t_q=t_q, t_k=t_k,
+            _dkv_kernel, sm_scale=sm_scale, causal=causal, window=window,
+            block_q=block_q, block_k=block_k, num_q=num_q, t_q=t_q, t_k=t_k,
             has_mask=has_mask),
         grid=(bh, num_k, num_q),
         in_specs=[
@@ -388,26 +411,29 @@ def _pad(x, multiple, axis):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, mask_bias, causal, sm_scale, block_q, block_k,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, mask_bias, causal, window, sm_scale, block_q, block_k,
            interpret):
     out, _ = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
-                  block_q=block_q, block_k=block_k, interpret=interpret)
+                  window=window, block_q=block_q, block_k=block_k,
+                  interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, mask_bias, causal, sm_scale, block_q, block_k,
-               interpret):
+def _flash_fwd(q, k, v, mask_bias, causal, window, sm_scale, block_q,
+               block_k, interpret):
     out, lse = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
-                    block_q=block_q, block_k=block_k, interpret=interpret)
+                    window=window, block_q=block_q, block_k=block_k,
+                    interpret=interpret)
     return out, (q, k, v, mask_bias, out, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, window, sm_scale, block_q, block_k, interpret, res,
+               do):
     q, k, v, mask_bias, out, lse = res
     dq, dk, dv = _bwd(q, k, v, mask_bias, out, lse, do, sm_scale=sm_scale,
-                      causal=causal, block_q=block_q, block_k=block_k,
-                      interpret=interpret)
+                      causal=causal, window=window, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
     dmb = None if mask_bias is None else jnp.zeros_like(mask_bias)
     return dq, dk, dv, dmb
 
@@ -416,6 +442,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
+                            window: int = 0,
                             kv_mask: Optional[jax.Array] = None,
                             interpret: bool = False) -> jax.Array:
     """Per-shard flash kernel over a (data, model) mesh: batch/head dims are
@@ -430,18 +457,18 @@ def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
     from jax.sharding import PartitionSpec as P
 
     if mesh is None:
-        return flash_attention(q, k, v, causal=causal, kv_mask=kv_mask,
-                               interpret=interpret)
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               kv_mask=kv_mask, interpret=interpret)
     spec = P("data", "model", None, None)
     if kv_mask is None:
-        fn = functools.partial(flash_attention, causal=causal,
+        fn = functools.partial(flash_attention, causal=causal, window=window,
                                interpret=interpret)
         return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)
 
     def fn(q, k, v, m):
-        return flash_attention(q, k, v, causal=causal, kv_mask=m,
-                               interpret=interpret)
+        return flash_attention(q, k, v, causal=causal, window=window,
+                               kv_mask=m, interpret=interpret)
 
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, P("data", None)),
@@ -450,6 +477,7 @@ def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
+                    window: int = 0,
                     kv_mask: Optional[jax.Array] = None,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
@@ -464,9 +492,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     mask). Rides through the kernels as a precomputed additive -inf bias
     row. A query row whose keys are ALL masked produces output 0 and
     gradient 0 (same contract as ``dense_attention``'s dead-row handling).
+
+    ``window > 0`` (requires ``causal``): sliding-window locality — query t
+    attends keys in (t-window, t]. Blocks entirely outside the window are
+    SKIPPED at the grid level, so compute is O(T·window) not O(T²/2).
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, H, T, D], got shape {q.shape}")
+    if window < 0 or (window and not causal):
+        raise ValueError(
+            f"window={window} must be >= 0 and requires causal=True")
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
     scale = float(sm_scale) if sm_scale is not None else d ** -0.5
@@ -481,6 +516,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             raise ValueError(
                 f"kv_mask shape {kv_mask.shape} != (batch, t_k)=({b}, {t_k})")
         mask_bias = _mask_bias(kv_mask, b, t_k, block_k)
-    out = _flash(qr, kr, vr, mask_bias, causal, scale, block_q, block_k,
-                 interpret)
+    out = _flash(qr, kr, vr, mask_bias, causal, int(window), scale,
+                 block_q, block_k, interpret)
     return out.reshape(b, h, t_q, d)

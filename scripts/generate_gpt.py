@@ -30,6 +30,8 @@ flags.DEFINE_string("size", "small", "small (gpt2-124M) | tiny — must match "
                     "the trained config")
 flags.DEFINE_integer("kv_heads", 0, "grouped-query attention heads; must "
                      "match the trained config (0 = plain MHA)")
+flags.DEFINE_integer("attn_window", 0, "sliding-window size; must match "
+                     "the trained config (0 = full causal)")
 flags.DEFINE_string("prompt", "", "comma-separated token ids; empty = a "
                     "fixed demo prompt")
 flags.DEFINE_integer("batch", 1, "decode batch size (prompt is broadcast)")
@@ -82,6 +84,7 @@ def main(argv):
             f"prompt ids must be in [0, {base.vocab_size})")
     total = len(prompt_ids) + FLAGS.n_new
     cfg = dataclasses.replace(base, kv_heads=FLAGS.kv_heads or None,
+                              attn_window=FLAGS.attn_window,
                               decode_len=total)
     model = gpt.GPT(cfg)
 

@@ -124,6 +124,29 @@ def child():
     for gi, gn in zip(range(3), ("dq", "dk", "dv")):
         ok &= record(f"flash_bwd_kv_mask_{gn}", g_fm[gi], g_dm[gi], tol=5e-2)
 
+    # --- sliding-window flash (grid-level block skip) vs dense+window ---
+    qw = jax.random.normal(kq, (2, 4, 256, 128), jnp.float32)
+    kw = jax.random.normal(kk, (2, 4, 256, 128), jnp.float32)
+    vw = jax.random.normal(kv, (2, 4, 256, 128), jnp.float32)
+
+    def loss_flash_w(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=True, window=96,
+                               block_q=64, block_k=64, interpret=False)
+        return jnp.sum(o * (1 + jnp.cos(o))), o
+
+    def loss_dense_w(q, k, v):
+        o = att.dense_attention(q, k, v, causal=True, window=96)
+        return jnp.sum(o * (1 + jnp.cos(o))), o
+
+    (_, o_fw), g_fw = jax.jit(jax.value_and_grad(
+        loss_flash_w, argnums=(0, 1, 2), has_aux=True))(qw, kw, vw)
+    with jax.default_matmul_precision("highest"):
+        (_, o_dw), g_dw = jax.jit(jax.value_and_grad(
+            loss_dense_w, argnums=(0, 1, 2), has_aux=True))(qw, kw, vw)
+    ok &= record("flash_fwd_window", o_fw, o_dw, tol=2e-2)
+    for gi, gn in zip(range(3), ("dq", "dk", "dv")):
+        ok &= record(f"flash_bwd_window_{gn}", g_fw[gi], g_dw[gi], tol=5e-2)
+
     # --- embed gather fwd + scatter-add bwd ---
     table = jax.random.normal(kt, (1000, 64), jnp.float32)
     ids = jax.random.randint(ki, (4, 37), 0, 1000)

@@ -31,6 +31,9 @@ flags.DEFINE_integer("moe_every", 0, "every k-th block uses Switch-MoE "
 flags.DEFINE_boolean("remat", False, "jax.checkpoint each block")
 flags.DEFINE_integer("kv_heads", 0, "grouped-query attention: shared K/V "
                      "heads (0 = plain MHA; must divide heads)")
+flags.DEFINE_integer("attn_window", 0, "sliding-window attention: each "
+                     "query sees the last N keys (0 = full causal; "
+                     "flash/dense impls only)")
 flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
                     "zigzag (load-balanced causal ring; needs mesh_seq>1)")
 flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
@@ -70,7 +73,8 @@ def main(argv):
 
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,
                               remat=FLAGS.remat, attn_impl=FLAGS.attn_impl,
-                              kv_heads=FLAGS.kv_heads or None)
+                              kv_heads=FLAGS.kv_heads or None,
+                              attn_window=FLAGS.attn_window)
     tx = optax.adamw(
         optax.warmup_cosine_decay_schedule(
             0.0, FLAGS.learning_rate,

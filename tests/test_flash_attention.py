@@ -129,6 +129,54 @@ def test_kv_mask_all_masked_row_zero_output_and_grad():
     np.testing.assert_array_equal(np.asarray(dv[1]), 0.0)
 
 
+@pytest.mark.parametrize("t,window", [(128, 32), (130, 48), (96, 96)])
+def test_window_matches_dense(t, window):
+    """Sliding-window flash == dense with the window mask, fwd and grads —
+    windows smaller than, straddling, and equal to block boundaries."""
+    b, h, d = 2, 2, 32
+    q, k, v = (_rand((b, h, t, d), jnp.float32, s) for s in range(3))
+    want = dense_attention(q, k, v, causal=True, window=window)
+    got = _flash(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    f = lambda q, k, v: _flash(  # noqa: E731
+        q, k, v, causal=True, window=window).sum()
+    g = lambda q, k, v: dense_attention(  # noqa: E731
+        q, k, v, causal=True, window=window).sum()
+    for a, b_ in zip(jax.grad(f, (0, 1, 2))(q, k, v),
+                     jax.grad(g, (0, 1, 2))(q, k, v)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_window_geq_t_equals_full_causal():
+    b, h, t, d = 1, 2, 64, 16
+    q, k, v = (_rand((b, h, t, d), jnp.float32, s) for s in range(3))
+    full = _flash(q, k, v, causal=True)
+    win = _flash(q, k, v, causal=True, window=t)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(win),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_window_composes_with_kv_mask():
+    b, h, t, d = 2, 2, 64, 16
+    q, k, v = (_rand((b, h, t, d), jnp.float32, s) for s in range(3))
+    mask = np.ones((b, t), bool)
+    mask[0, 50:] = False
+    mask = jnp.asarray(mask)
+    bias = jnp.where(mask[:, None, None, :], 0.0, -jnp.inf)
+    want = dense_attention(q, k, v, causal=True, window=24, bias=bias)
+    got = _flash(q, k, v, causal=True, window=24, kv_mask=mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_window_requires_causal():
+    q, k, v = (_rand((1, 1, 16, 8), jnp.float32, s) for s in range(3))
+    with pytest.raises(ValueError, match="causal"):
+        _flash(q, k, v, causal=False, window=8)
+
+
 def test_kv_mask_shape_validated():
     q, k, v = (_rand((2, 2, 16, 8), jnp.float32, s) for s in range(3))
     with pytest.raises(ValueError, match="kv_mask"):

@@ -1,5 +1,7 @@
 """GPT decoder LM: learning, TP/SP/EP parity, flash-vs-dense equivalence."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -267,6 +269,79 @@ def test_generate_top_k1_equals_greedy():
                            temperature=1.7, top_k=1,
                            rng=jax.random.PRNGKey(42))
     np.testing.assert_array_equal(np.asarray(greedy), np.asarray(sampled))
+
+
+def test_gpt_window_locality_and_decode_parity():
+    """attn_window: (a) a single-layer model's logits at position t are
+    invariant to tokens older than the window; (b) windowed KV-cache decode
+    == windowed full forward per position."""
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="dense",
+                             attn_window=4)
+    cfg = dataclasses.replace(cfg, layers=1)
+    model, init_fn = gpt.make_init(cfg, seq_len=16)
+    variables = init_fn(jax.random.PRNGKey(0))
+    ids = jnp.asarray(data_batch(n=2)["input_ids"][:, :16])
+    base = model.apply(variables, ids)
+    ids2 = np.array(ids).copy()
+    ids2[:, 0] = (ids2[:, 0] + 1) % cfg.vocab_size   # outside pos-10's window
+    pert = model.apply(variables, jnp.asarray(ids2))
+    np.testing.assert_allclose(np.asarray(base[:, 10:]),
+                               np.asarray(pert[:, 10:]), atol=1e-5)
+
+    cfg_dec = dataclasses.replace(cfg, decode_len=16)
+    model_dec = gpt.GPT(cfg_dec)
+    cache = model_dec.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32))["cache"]
+    got = []
+    for t in range(16):
+        logits, mut = model_dec.apply(
+            {"params": variables["params"], "cache": cache},
+            ids[:, t:t + 1], mutable=["cache"])
+        cache = mut["cache"]
+        got.append(logits[:, 0])
+    np.testing.assert_allclose(np.asarray(jnp.stack(got, axis=1)),
+                               np.asarray(base), rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_window_flash_matches_dense():
+    cfg_d = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="dense",
+                               attn_window=8)
+    cfg_f = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="flash",
+                               attn_window=8)
+    model_d, init_fn = gpt.make_init(cfg_d, seq_len=SEQ)
+    model_f, _ = gpt.make_init(cfg_f, seq_len=SEQ)
+    variables = init_fn(jax.random.PRNGKey(0))
+    ids = jnp.asarray(data_batch(n=2)["input_ids"])
+    np.testing.assert_allclose(
+        np.asarray(model_d.apply(variables, ids)),
+        np.asarray(model_f.apply(variables, ids)), rtol=1e-4, atol=1e-4)
+
+
+def test_gpt_window_rejects_ring():
+    cfg = gpt.GPTConfig.tiny(attn_impl="ring", attn_window=8)
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    model, init_fn = gpt.make_init(cfg, mesh, seq_len=SEQ)
+    with pytest.raises(ValueError, match="not supported"):
+        init_fn(jax.random.PRNGKey(0))
+    # negative windows are config errors, not silent all-masked attention
+    with pytest.raises(ValueError, match="attn_window"):
+        gpt.GPTConfig.tiny(attn_window=-4)
+
+
+def test_gpt_window_unsharded_zigzag_falls_back_to_windowed_dense():
+    """zigzag WITHOUT seq sharding is just dense — a window must work there
+    and match the dense impl, not be spuriously rejected."""
+    cfg_z = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="zigzag",
+                               attn_window=8)
+    cfg_d = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="dense",
+                               attn_window=8)
+    model_z, init_fn = gpt.make_init(cfg_z, seq_len=SEQ)
+    model_d, _ = gpt.make_init(cfg_d, seq_len=SEQ)
+    variables = init_fn(jax.random.PRNGKey(0))
+    ids = jnp.asarray(data_batch(n=2)["input_ids"])
+    np.testing.assert_allclose(
+        np.asarray(model_z.apply(variables, ids)),
+        np.asarray(model_d.apply(variables, ids)), rtol=1e-6, atol=1e-6)
 
 
 def test_gpt_gqa_learns_and_cache_is_smaller(mesh8):
