@@ -241,7 +241,14 @@ class CausalSelfAttention(nn.Module):
                 out = att.dense_attention(q, k, v, causal=True,
                                           window=cfg.attn_window)
         elif impl == "ring":
-            out = att.ring_attention_sharded(q, k, v, self.mesh, causal=True)
+            if cfg.attn_window and not seq_sharded:
+                # ring's own seq=1 fallback is windowless dense — route the
+                # window explicitly rather than silently train full-causal
+                out = att.dense_attention(q, k, v, causal=True,
+                                          window=cfg.attn_window)
+            else:
+                out = att.ring_attention_sharded(q, k, v, self.mesh,
+                                                 causal=True)
         elif impl == "flash":
             out = fa.flash_attention_sharded(
                 q, k, v, self.mesh, causal=True, window=cfg.attn_window,

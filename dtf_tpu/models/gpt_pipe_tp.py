@@ -121,7 +121,7 @@ def apply_block(cfg: GPTConfig, tp_axis: Optional[str], p: PyTree,
     positions = jnp.arange(t)
     q = rope(q, positions, cfg.rope_theta)
     k = rope(k, positions, cfg.rope_theta)
-    out = att.dense_attention(q, k, v, causal=True)
+    out = att.dense_attention(q, k, v, causal=True, window=cfg.attn_window)
     out = out.transpose(0, 2, 1, 3).reshape(b, t, -1)
     x = x + _row(p["attn_out"], out, dtype, tp_axis)
 
@@ -188,6 +188,12 @@ def _check(cfg: GPTConfig, mesh: Mesh, axis_name: str, tp_axis: str) -> int:
         raise ValueError(
             f"TP-in-pipe blocks use per-shard dense attention; "
             f"attn_impl={cfg.attn_impl!r} is not supported here")
+    if cfg.kv_heads is not None and cfg.kv_heads != cfg.heads:
+        # this path builds its own full-width K/V params; accepting a GQA
+        # config would silently train plain MHA under a GQA label
+        raise ValueError(
+            "grouped-query attention (kv_heads) is not supported in the "
+            "TP-in-pipe path; use the plain or pipeline-only GPT")
     return per_row
 
 

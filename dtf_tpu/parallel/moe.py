@@ -49,6 +49,23 @@ class MoeConfig:
     #: shape that keeps dispatch memory linear in tokens; 1 → flat dispatch
     #: over all tokens (only sane for toy shapes — memory is quadratic).
     num_groups: int | None = None
+    #: experts per token: 1 = Switch, 2 = GShard top-2 (normalized gates;
+    #: second choices queue behind all first choices for capacity).
+    top_k: int = 1
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k={self.top_k} must be 1 or 2")
+
+
+def expert_capacity(tokens_per_group: int, num_experts: int,
+                    cfg: MoeConfig) -> int:
+    """Slots per expert per group: ``cf · top_k · s / e`` (GShard sets
+    C ∝ k — top-2 routes ~2s/e entries per expert, and since second choices
+    queue behind firsts, an unscaled capacity would drop essentially every
+    second choice, silently degrading to a down-gated top-1)."""
+    return max(1, int(cfg.capacity_factor * cfg.top_k
+                      * tokens_per_group / num_experts))
 
 
 def top1_dispatch(router_logits: jax.Array, num_experts: int,
@@ -79,6 +96,46 @@ def top1_dispatch(router_logits: jax.Array, num_experts: int,
     return dispatch, combine, aux
 
 
+def top2_dispatch(router_logits: jax.Array, num_experts: int,
+                  capacity: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """GShard top-2 routing → (dispatch [G,E,C], combine [G,E,C], aux).
+
+    Each token goes to its two highest-probability experts with gates
+    renormalized over the pair. Capacity policy (GShard §3.3): within an
+    expert's queue, ALL first choices precede second choices, so overflow
+    drops second choices first. ``aux`` is the same first-choice
+    load-balance term as top-1.
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    g1 = probs.max(axis=-1)                                     # [G]
+    oh1 = jax.nn.one_hot(probs.argmax(axis=-1), num_experts,
+                         dtype=jnp.float32)                     # [G,E]
+    probs2 = probs * (1.0 - oh1)
+    g2 = probs2.max(axis=-1)
+    oh2 = jax.nn.one_hot(probs2.argmax(axis=-1), num_experts,
+                         dtype=jnp.float32)
+    denom = g1 + g2 + 1e-9
+    g1n, g2n = g1 / denom, g2 / denom
+
+    pos1 = jnp.cumsum(oh1, axis=0) * oh1 - 1.0                  # [G,E]
+    # second choices queue AFTER every first choice bound for that expert
+    pos2 = (jnp.cumsum(oh2, axis=0)
+            + oh1.sum(axis=0, keepdims=True)) * oh2 - 1.0
+    d_parts = []
+    for pos, oh in ((pos1, oh1), (pos2, oh2)):
+        in_cap = (pos < capacity) & (oh > 0)
+        slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+        d_parts.append(jax.nn.one_hot(slot, capacity, dtype=jnp.float32)
+                       * in_cap[..., None])
+    dispatch = d_parts[0] + d_parts[1]                          # disjoint
+    combine = (d_parts[0] * g1n[:, None, None]
+               + d_parts[1] * g2n[:, None, None])
+    frac_tokens = oh1.mean(axis=0)
+    frac_probs = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(frac_tokens * frac_probs)
+    return dispatch, combine, aux
+
+
 class SwitchFFN(nn.Module):
     """Expert-parallel FFN block (drop-in for a dense MLP in a transformer).
 
@@ -103,13 +160,14 @@ class SwitchFFN(nn.Module):
         if g % n:
             raise ValueError(f"num_groups={n} must divide tokens {g} (={b}x{t})")
         s = g // n  # tokens per group; the capacity race runs within a group
-        capacity = max(1, int(self.cfg.capacity_factor * s / e))
+        capacity = expert_capacity(s, e, self.cfg)
         tokens = x.reshape(n, s, d)
 
         router = nn.Dense(e, dtype=jnp.float32, param_dtype=jnp.float32,
                           name="router")
+        route = top1_dispatch if self.cfg.top_k == 1 else top2_dispatch
         dispatch, combine, aux = jax.vmap(
-            top1_dispatch, in_axes=(0, None, None))(
+            route, in_axes=(0, None, None))(
                 router(tokens), e, capacity)  # [n,s,e,c] x2, aux [n]
         self.sow("losses", "moe_aux", jnp.mean(aux))
 

@@ -28,6 +28,8 @@ flags.DEFINE_string("size", "small", "small (gpt2-124M) | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
 flags.DEFINE_integer("moe_every", 0, "every k-th block uses Switch-MoE "
                      "(0 = dense)")
+flags.DEFINE_integer("moe_top_k", 1, "experts per token: 1 = Switch, "
+                     "2 = GShard top-2 (normalized gates)")
 flags.DEFINE_boolean("remat", False, "jax.checkpoint each block")
 flags.DEFINE_integer("kv_heads", 0, "grouped-query attention: shared K/V "
                      "heads (0 = plain MHA; must divide heads)")
@@ -74,7 +76,9 @@ def main(argv):
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,
                               remat=FLAGS.remat, attn_impl=FLAGS.attn_impl,
                               kv_heads=FLAGS.kv_heads or None,
-                              attn_window=FLAGS.attn_window)
+                              attn_window=FLAGS.attn_window,
+                              moe=dataclasses.replace(
+                                  base.moe, top_k=FLAGS.moe_top_k))
     tx = optax.adamw(
         optax.warmup_cosine_decay_schedule(
             0.0, FLAGS.learning_rate,

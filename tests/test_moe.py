@@ -145,3 +145,99 @@ def test_ep_gradients_finite_under_mesh():
         assert np.all(np.isfinite(np.asarray(leaf)))
     # router must receive gradient (through the combine gate)
     assert float(jnp.abs(g["router"]["kernel"]).sum()) > 0.0
+
+
+def test_top2_dispatch_gates_and_slots():
+    """Unambiguous routing with ample capacity: each token lands in its two
+    top experts with pair-normalized gates; slots are disjoint."""
+    logits = jnp.array([[3.0, 2.0, -5.0],
+                        [-5.0, 3.0, 2.0],
+                        [2.0, -5.0, 3.0]])
+    dispatch, combine, aux = moe.top2_dispatch(logits, 3, capacity=4)
+    probs = jax.nn.softmax(logits, -1)
+    # token 0: first expert 0, second expert 1
+    assert dispatch[0, 0].sum() == 1.0 and dispatch[0, 1].sum() == 1.0
+    assert dispatch[0, 2].sum() == 0.0
+    denom = probs[0, 0] + probs[0, 1]
+    np.testing.assert_allclose(float(combine[0, 0].sum()),
+                               float(probs[0, 0] / denom), rtol=1e-5)
+    np.testing.assert_allclose(float(combine[0, 1].sum()),
+                               float(probs[0, 1] / denom), rtol=1e-5)
+    # every (expert, slot) holds at most one token
+    assert float(dispatch.sum(axis=0).max()) <= 1.0
+    assert np.isfinite(float(aux))
+
+
+def test_top2_dispatch_second_choices_drop_first():
+    """Capacity pressure drops SECOND choices before any first choice
+    (GShard queue policy: firsts precede seconds)."""
+    # all tokens: first choice expert 0, second choice expert 1
+    logits = jnp.tile(jnp.array([[3.0, 2.0, -9.0]]), (3, 1))
+    dispatch, _, _ = moe.top2_dispatch(logits, 3, capacity=2)
+    # expert 0 (all first choices): tokens 0,1 kept, token 2 dropped
+    assert float(dispatch[0, 0].sum()) == 1.0
+    assert float(dispatch[1, 0].sum()) == 1.0
+    assert float(dispatch[2, 0].sum()) == 0.0
+    # expert 1 (all second choices): same order
+    assert float(dispatch[0, 1].sum()) == 1.0
+    assert float(dispatch[1, 1].sum()) == 1.0
+    assert float(dispatch[2, 1].sum()) == 0.0
+
+
+def test_top2_ffn_matches_manual_two_expert_mix():
+    """With ample capacity, top-2 FFN output == g1n*FFN_e1(x) + g2n*FFN_e2(x)
+    computed by hand from the router probabilities."""
+    cfg = moe.MoeConfig(num_experts=4, top_k=2, capacity_factor=8.0,
+                        num_groups=1)
+    m = moe.SwitchFFN(d_model=8, d_ff=16, cfg=cfg, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (1, 6, 8))
+    variables = m.init(jax.random.PRNGKey(1), x)
+    got, _ = m.apply(variables, x, mutable=["losses"])
+
+    p = variables["params"]
+    tokens = x.reshape(-1, 8)
+    logits = tokens @ p["router"]["kernel"] + p["router"]["bias"]
+    probs = jax.nn.softmax(logits, -1)
+    want = []
+    for i, tok in enumerate(tokens):
+        order = jnp.argsort(-probs[i])
+        e1, e2 = int(order[0]), int(order[1])
+        g1, g2 = float(probs[i, e1]), float(probs[i, e2])
+
+        def ffn(e, tok=tok):
+            h = jax.nn.gelu(tok @ p["w_in"][e], approximate=True)
+            return h @ p["w_out"][e]
+
+        want.append((g1 * ffn(e1) + g2 * ffn(e2)) / (g1 + g2 + 1e-9))
+    np.testing.assert_allclose(np.asarray(got.reshape(-1, 8)),
+                               np.asarray(jnp.stack(want)),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_top2_expert_parallel_matches_single_device():
+    mesh = make_mesh(MeshConfig(data=2, expert=4))
+    cfg = moe.MoeConfig(num_experts=4, top_k=2)
+    m = moe.SwitchFFN(d_model=8, d_ff=16, cfg=cfg, dtype=jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 8, 8))
+    variables = m.init(jax.random.PRNGKey(1), x)
+    want = m.apply(variables, x)
+    sh = tree_shardings(variables["params"], mesh, moe.ep_rules())
+    sharded = jax.device_put(variables["params"], sh)
+    got = jax.jit(lambda pr, xx: m.apply({"params": pr}, xx))(sharded, x)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_moe_config_validates_top_k():
+    with pytest.raises(ValueError, match="top_k"):
+        moe.MoeConfig(top_k=3)
+
+
+def test_expert_capacity_scales_with_top_k():
+    """Regression: at the default capacity factor, top-2 must get 2x the
+    slots of top-1 — otherwise second choices (which queue behind firsts)
+    are all dropped and top-2 silently degrades to down-gated top-1."""
+    c1 = moe.expert_capacity(64, 8, moe.MoeConfig(top_k=1))
+    c2 = moe.expert_capacity(64, 8, moe.MoeConfig(top_k=2))
+    assert c2 == 2 * c1
+    assert moe.expert_capacity(1, 64, moe.MoeConfig()) == 1  # floor
