@@ -116,18 +116,37 @@ class PreemptionHook(Hook):
     ``checkpoint_every - 1`` lost on a plain kill; that crash path is
     exercised by tests/test_fault_injection.py).
 
+    Multi-host: the save is a COLLECTIVE Orbax write, and the signal lands
+    at different instants on different hosts — acting on the local flag
+    alone would have hosts calling save() at different steps and
+    deadlocking. So under ``jax.process_count() > 1`` the flag is
+    OR-allgathered at each step boundary: collectives match in program
+    order, so every host evaluates the k-th sync at the same step and they
+    all agree to save that step (the cluster manager signals every host of
+    an evicted slice, so the OR converges within one step).
+
     Must be constructed and ``begin()``-run in the main thread (CPython's
     ``signal.signal`` requirement). Restores the previous handlers at
     ``end()`` so short-lived Trainers don't leak handler state.
     """
 
-    def __init__(self, ckpt: Checkpointer, signals=(signal.SIGTERM,)):
+    def __init__(self, ckpt: Checkpointer, signals=(signal.SIGTERM,),
+                 check_every: int = 8):
+        #: multi-host flag-sync cadence: the OR-allgather is a device
+        #: collective whose result the host blocks on, so syncing every
+        #: step would forfeit async-dispatch run-ahead; every ``check_every``
+        #: steps bounds the reaction delay (grace windows are ~30 s, steps
+        #: are ms–s) while amortizing the barrier. Single-host runs react
+        #: at the very next step regardless.
         self.ckpt = ckpt
         self.signals = tuple(signals)
+        self.check_every = max(1, check_every)
         self.preempted = False
         self._prev: dict = {}
+        self._multiprocess = False
 
     def begin(self, state):
+        self._multiprocess = jax.process_count() > 1
         for s in self.signals:
             self._prev[s] = signal.signal(s, self._on_signal)
 
@@ -135,7 +154,18 @@ class PreemptionHook(Hook):
         self.preempted = True
 
     def after_step(self, step, state, metrics):
-        if self.preempted:
+        flag = self.preempted
+        if self._multiprocess:
+            if step % self.check_every:
+                # between sync points even a locally-set flag must wait:
+                # acting alone would desync the collective order
+                return
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            flag = bool(multihost_utils.process_allgather(
+                np.asarray([self.preempted])).any())
+        if flag:
             self.ckpt.save(step, state, force=True)
             self.ckpt.wait()
             raise StopTraining

@@ -220,6 +220,57 @@ def test_two_process_pipeline_parallel_matches_single_process(tmp_path):
     np.testing.assert_allclose(l0, _reference_pipe_losses(), rtol=1e-5)
 
 
+def test_two_process_graceful_preemption_and_resume(tmp_path):
+    """SIGTERM both workers mid-run: the PreemptionHook's flag OR-allgather
+    must have BOTH hosts save the SAME step collectively (a per-host local
+    decision would deadlock the collective Orbax write), exit 0, and a
+    relaunch must resume from that exact step."""
+    import signal
+    import time
+
+    logdir = str(tmp_path / "run")
+    port = _free_port()
+    worker = os.path.join(ROOT, "tests", "_mp_worker_preempt.py")
+
+    def launch(steps):
+        return [subprocess.Popen(
+            [sys.executable, worker, str(i), "2", str(port), logdir,
+             str(steps)],
+            env=_env(), stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for i in range(2)]
+
+    procs = launch(1_000_000)
+    try:
+        time.sleep(40)  # bootstrap + compile + a batch of steps
+        for p in procs:
+            assert p.poll() is None, p.stdout.read()[-2000:]
+            os.kill(p.pid, signal.SIGTERM)
+        outs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    ckpt_dir = os.path.join(logdir, "ckpt")
+    steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    assert steps, "no preemption checkpoint landed"
+    saved = max(steps)
+    assert saved >= 1
+
+    # relaunch both with a finite target just past the saved step
+    procs2 = launch(saved + 3)
+    try:
+        outs2 = [p.communicate(timeout=240)[0] for p in procs2]
+    finally:
+        for p in procs2:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs2, outs2):
+        assert p.returncode == 0, out[-2000:]
+        assert f"done: step={saved + 3}" in out, out[-2000:]
+
+
 def test_two_process_tp_zero1_bert_with_cross_host_checkpoint(tmp_path):
     """TP collectives + ZeRO-1 shards + Orbax sharded save/restore across a
     real process boundary: 2 processes x 2 devices, mesh (data=2, model=2).
