@@ -59,8 +59,9 @@ class GPTConfig:
     attn_impl: str = "auto"
     #: sliding-window attention: query t sees keys in (t-window, t].
     #: 0 = full causal. O(T·window) compute on the flash path (out-of-window
-    #: blocks are grid-skipped). Not supported with ring/zigzag seq sharding
-    #: (the ring would still rotate all K/V shards).
+    #: blocks are grid-skipped). Under seq sharding, ring/auto routes to
+    #: halo attention (one neighbor-tail ppermute, no ring rotation);
+    #: zigzag rejects windows (its permuted layout breaks locality).
     attn_window: int = 0
     #: every k-th block uses a Switch-MoE FFN (0 = all dense).
     moe_every: int = 0
@@ -226,14 +227,12 @@ class CausalSelfAttention(nn.Module):
         # transient — the cache/params only ever hold kv_heads.
         k, v = expand_kv(k), expand_kv(v)
 
-        if cfg.attn_window and seq_sharded and impl in ("ring", "zigzag"):
-            # only the actually-sharded ring is incompatible; unsharded
-            # configs fall through to dense which supports windows
+        if cfg.attn_window and seq_sharded and impl == "zigzag":
             raise ValueError(
                 f"attn_window={cfg.attn_window} is not supported with "
-                f"seq-sharded attn_impl={impl!r} (the ring rotates ALL K/V "
-                "shards); use flash/dense, or shard long local-attention "
-                "sequences over data instead of seq")
+                "seq-sharded zigzag (the permuted layout breaks locality); "
+                "use attn_impl=ring — windowed seq sharding routes to halo "
+                "attention, which is already load-balanced")
         if impl == "zigzag":
             if seq_sharded:
                 out = att.zigzag_ring_attention_sharded(q, k, v, self.mesh)
@@ -241,7 +240,12 @@ class CausalSelfAttention(nn.Module):
                 out = att.dense_attention(q, k, v, causal=True,
                                           window=cfg.attn_window)
         elif impl == "ring":
-            if cfg.attn_window and not seq_sharded:
+            if cfg.attn_window and seq_sharded:
+                # windowed + seq-sharded: halo attention — one neighbor-
+                # tail ppermute instead of rotating every K/V shard
+                out = att.halo_attention_sharded(q, k, v, self.mesh,
+                                                 window=cfg.attn_window)
+            elif cfg.attn_window:
                 # ring's own seq=1 fallback is windowless dense — route the
                 # window explicitly rather than silently train full-causal
                 out = att.dense_attention(q, k, v, causal=True,

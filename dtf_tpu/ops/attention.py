@@ -295,6 +295,99 @@ def zigzag_ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                          out_specs=spec)(q, k, v)
 
 
+def halo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   window: int,
+                   axis_name: str = "seq",
+                   q_chunk: int = 256,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Sliding-window causal attention under sequence sharding (call inside
+    shard_map) — the window × context-parallel composition.
+
+    With ``window - 1 <= local shard length``, a query needs at most the
+    PREVIOUS shard's (window-1)-token tail, so instead of rotating all K/V
+    around the ring (n-1 ppermutes touching every shard), each shard fetches
+    one neighbor halo with a single ppermute and attends locally:
+    O(t_local · (t_local + window)) work, O(window) communication — the
+    locality win survives the sharding.
+
+    Shard 0's halo arrives wrapped from the LAST shard; its computed global
+    positions are negative ("before the sequence start"), and the
+    ``k_pos >= 0`` mask kills it, so the wrapped values are never read.
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, h, t, d = q.shape                       # local shapes
+    halo = window - 1
+    scale = sm_scale if sm_scale is not None else d ** -0.5
+
+    if halo > 0:
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        k_halo = jax.lax.ppermute(k[:, :, t - halo:], axis_name, perm)
+        v_halo = jax.lax.ppermute(v[:, :, t - halo:], axis_name, perm)
+        kk = jnp.concatenate([k_halo, k], axis=2)       # [b,h,halo+t,d]
+        vv = jnp.concatenate([v_halo, v], axis=2)
+    else:
+        kk, vv = k, v
+
+    # Query-chunked local attention: a full [t, t+halo] score matrix would
+    # be O(t_local²) memory — quadratic on exactly the long-context path
+    # this exists for. Chunk rows p ∈ [i·c, i·c+c) attend kk slice
+    # [i·c, i·c+c+halo) (kk index j ↔ global k position idx·t - halo + j),
+    # so live memory is O(c·(c+halo)) per (b, h) and chunks run under
+    # lax.map. c must divide t; the largest divisor ≤ q_chunk is used.
+    c = t if t <= q_chunk else max(
+        div for div in range(1, q_chunk + 1) if t % div == 0)
+
+    def chunk(i):
+        qs = jax.lax.dynamic_slice_in_dim(q, i * c, c, axis=2)
+        ks_ = jax.lax.dynamic_slice_in_dim(kk, i * c, c + halo, axis=2)
+        vs_ = jax.lax.dynamic_slice_in_dim(vv, i * c, c + halo, axis=2)
+        q_pos = idx * t + i * c + jnp.arange(c)          # global positions
+        k_pos = idx * t - halo + i * c + jnp.arange(c + halo)
+        diff = q_pos[:, None] - k_pos[None, :]
+        # k_pos >= 0 kills shard 0's wrapped halo ("before sequence start")
+        keep = (diff >= 0) & (diff < window) & (k_pos[None, :] >= 0)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qs, ks_,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(keep[None, None], s, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1)                   # diag always live
+        return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), vs_)
+
+    if c == t:
+        return chunk(0)
+    out = jax.lax.map(chunk, jnp.arange(t // c))         # [n_c,b,h,c,d]
+    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, t, d)
+
+
+def halo_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh, *, window: int, q_chunk: int = 256,
+                           sm_scale: Optional[float] = None) -> jax.Array:
+    """Global-array wrapper: shard_map(halo_attention) over ``seq``.
+
+    Expects [B,H,T,D] with B on ``data``, H on ``model``, T on ``seq`` in
+    NATURAL order (no zigzag — windowed attention is already load-balanced:
+    every shard does the same local work). Falls back to windowed dense
+    when the seq axis is trivial.
+    """
+    if window < 1:
+        raise ValueError(f"window={window} must be >= 1")
+    seq_shards = mesh.shape.get("seq", 1)
+    if seq_shards == 1:
+        return dense_attention(q, k, v, causal=True, window=window,
+                               sm_scale=sm_scale)
+    t_local = q.shape[2] // seq_shards
+    if window - 1 > t_local:
+        raise ValueError(
+            f"window={window} needs a halo of {window - 1} tokens but each "
+            f"seq shard holds only {t_local}; use fewer seq shards (or ring "
+            "attention without a window)")
+    spec = P("data", "model", "seq", None)
+    fn = functools.partial(halo_attention, window=window, q_chunk=q_chunk,
+                          sm_scale=sm_scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)(q, k, v)
+
+
 def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
                            mesh: Mesh, kv_mask: Optional[jax.Array] = None,
                            *, causal: bool = False,

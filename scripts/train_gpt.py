@@ -34,8 +34,9 @@ flags.DEFINE_boolean("remat", False, "jax.checkpoint each block")
 flags.DEFINE_integer("kv_heads", 0, "grouped-query attention: shared K/V "
                      "heads (0 = plain MHA; must divide heads)")
 flags.DEFINE_integer("attn_window", 0, "sliding-window attention: each "
-                     "query sees the last N keys (0 = full causal; "
-                     "flash/dense impls only)")
+                     "query sees the last N keys (0 = full causal). With "
+                     "mesh_seq>1 this routes to halo attention (one "
+                     "neighbor-tail ppermute); zigzag rejects windows")
 flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
                     "zigzag (load-balanced causal ring; needs mesh_seq>1)")
 flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "

@@ -2,6 +2,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dtf_tpu.ops import attention as att
@@ -186,3 +187,72 @@ def test_sharded_xent_ignore_index():
     all_ignored, n0 = softmax_cross_entropy(
         logits, jnp.full((4,), -100), ignore_index=-100)
     assert float(all_ignored) == 0.0
+
+
+def test_halo_attention_matches_dense_window():
+    """Halo (windowed + seq-sharded, one neighbor ppermute) == windowed
+    dense on the full sequence — fwd and grads, windows crossing shard
+    boundaries and at the t_local edge."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    b, h, t, d = 2, 2, 64, 16          # t_local = 16
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32) for kk in ks)
+    for window in (5, 16, 17):         # halo 4 / 15 / 16(=t_local edge)
+        want = att.dense_attention(q, k, v, causal=True, window=window)
+        got = att.halo_attention_sharded(q, k, v, mesh, window=window)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+        gw = jax.grad(lambda q, k, v: att.dense_attention(
+            q, k, v, causal=True, window=window).sum(), (0, 1, 2))(q, k, v)
+        gg = jax.grad(lambda q, k, v: att.halo_attention_sharded(
+            q, k, v, mesh, window=window).sum(), (0, 1, 2))(q, k, v)
+        for a, b_ in zip(gg, gw):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_halo_attention_chunked_matches_unchunked():
+    """The O(chunk·(chunk+halo))-memory query-chunked path (q_chunk smaller
+    than t_local forces lax.map over chunks) == the windowed dense oracle,
+    fwd and grads."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    b, h, t, d = 2, 2, 64, 16          # t_local = 16; q_chunk=4 → 4 chunks
+    ks = jax.random.split(jax.random.PRNGKey(2), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32) for kk in ks)
+    want = att.dense_attention(q, k, v, causal=True, window=7)
+    got = att.halo_attention_sharded(q, k, v, mesh, window=7, q_chunk=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    gw = jax.grad(lambda q, k, v: att.dense_attention(
+        q, k, v, causal=True, window=7).sum(), (0, 1, 2))(q, k, v)
+    gg = jax.grad(lambda q, k, v: att.halo_attention_sharded(
+        q, k, v, mesh, window=7, q_chunk=4).sum(), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(gg, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_halo_attention_rejects_window_past_shard():
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    q = jnp.zeros((2, 2, 64, 16))      # t_local = 16, halo would be 17
+    with pytest.raises(ValueError, match="halo"):
+        att.halo_attention_sharded(q, q, q, mesh, window=18)
+
+
+def test_halo_attention_trivial_seq_axis_is_windowed_dense():
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=8))
+    b, h, t, d = 1, 2, 32, 8
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32) for kk in ks)
+    np.testing.assert_allclose(
+        np.asarray(att.halo_attention_sharded(q, k, v, mesh, window=7)),
+        np.asarray(att.dense_attention(q, k, v, causal=True, window=7)),
+        rtol=1e-6, atol=1e-6)

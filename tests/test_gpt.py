@@ -317,8 +317,19 @@ def test_gpt_window_flash_matches_dense():
         np.asarray(model_f.apply(variables, ids)), rtol=1e-4, atol=1e-4)
 
 
-def test_gpt_window_rejects_ring():
-    cfg = gpt.GPTConfig.tiny(attn_impl="ring", attn_window=8)
+def test_gpt_window_seq_sharded_halo_matches_dp():
+    """Windowed + seq-sharded (ring/auto → halo attention) trains to the
+    same losses as the windowed DP run."""
+    cfg = gpt.GPTConfig.tiny(attn_window=8)
+    mesh_dp = make_mesh(MeshConfig(data=8))
+    mesh_sp = make_mesh(MeshConfig(data=2, seq=4))
+    _, l_dp = run(mesh_dp, steps=3, cfg=cfg)
+    _, l_sp = run(mesh_sp, steps=3, cfg=cfg, sp=True)
+    np.testing.assert_allclose(l_dp, l_sp, rtol=8e-4)
+
+
+def test_gpt_window_rejects_zigzag_and_negative():
+    cfg = gpt.GPTConfig.tiny(attn_impl="zigzag", attn_window=8)
     mesh = make_mesh(MeshConfig(data=2, seq=4))
     model, init_fn = gpt.make_init(cfg, mesh, seq_len=SEQ)
     with pytest.raises(ValueError, match="not supported"):
