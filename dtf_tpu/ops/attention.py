@@ -314,10 +314,22 @@ def halo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     positions are negative ("before the sequence start"), and the
     ``k_pos >= 0`` mask kills it, so the wrapped values are never read.
     """
+    if window < 1:
+        # window=0 means "full causal" everywhere else; here it would make
+        # halo=-1 and an all-False keep mask → silent all-NaN softmax
+        raise ValueError(
+            f"window={window} must be >= 1 (use ring_attention for full "
+            "causal under seq sharding)")
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, h, t, d = q.shape                       # local shapes
     halo = window - 1
+    if halo > t:
+        # validate HERE too (shapes are static): direct shard_map callers
+        # would otherwise hit an opaque dynamic-slice error
+        raise ValueError(
+            f"window={window} needs a {halo}-token halo but the local "
+            f"shard holds only {t} tokens")
     scale = sm_scale if sm_scale is not None else d ** -0.5
 
     if halo > 0:
