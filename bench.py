@@ -145,8 +145,48 @@ def main():
     if result is None:
         result = {"metric": METRIC, "value": 0, "unit": "images/sec/chip",
                   "vs_baseline": 0, "error": "; ".join(errors)[:2000]}
+    _attach_companion_metrics(result)
     print(json.dumps(result))
     return 0  # structured error on stdout IS the contract; rc 0 so it lands
+
+
+def _attach_companion_metrics(result: dict) -> None:
+    """Surface the transformer-side numbers in the one driver-recorded line.
+
+    The headline metric is the BASELINE's ResNet-50 throughput, but the
+    ≥60%-MFU north star is only physically reachable on matmul-dominated
+    LM workloads (PERF.md §1) — so when scripts/bench_lm.py /
+    bench_attention.py artifacts exist, their key numbers ride along.
+    Best-effort: a missing/partial artifact attaches nothing.
+    """
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def rows_of(name, *keys):
+        """Best-effort artifact rows; ANY malformation yields [] — this
+        helper must never be able to break the one-JSON-line contract."""
+        try:
+            with open(os.path.join(root, name)) as f:
+                data = json.load(f)
+            for key in keys:
+                data = data.get(key, {}) if isinstance(data, dict) else {}
+            return [r for r in data if isinstance(r, dict)] \
+                if isinstance(data, list) else []
+        except Exception:
+            return []
+
+    for row in rows_of("BENCH_LM.json", "rows"):
+        if row.get("backend") != "tpu":
+            continue  # CPU-sim tiny rows must not pose as TPU numbers
+        name = row.get("model")
+        if name in ("gpt", "bert") and "tokens_per_sec" in row:
+            result[f"{name}_tokens_per_sec"] = row["tokens_per_sec"]
+            if "mfu_analytic" in row:
+                result[f"{name}_mfu"] = row["mfu_analytic"]
+        elif name == "widedeep" and "examples_per_sec" in row:
+            result["widedeep_examples_per_sec"] = row["examples_per_sec"]
+    for row in rows_of("ATTN_BENCH.json", "tpu", "rows"):
+        if row.get("seq") == 8192 and "fwd_speedup" in row:
+            result["flash_vs_dense_fwd_8k"] = row["fwd_speedup"]
 
 
 if __name__ == "__main__":
