@@ -145,7 +145,10 @@ def main():
     if result is None:
         result = {"metric": METRIC, "value": 0, "unit": "images/sec/chip",
                   "vs_baseline": 0, "error": "; ".join(errors)[:2000]}
-    _attach_companion_metrics(result)
+    if "error" not in result:
+        # a failed headline run must not carry stale artifact numbers that
+        # read as this run's measurements
+        _attach_companion_metrics(result)
     print(json.dumps(result))
     return 0  # structured error on stdout IS the contract; rc 0 so it lands
 
