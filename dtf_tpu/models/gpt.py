@@ -164,11 +164,18 @@ class CausalSelfAttention(nn.Module):
             # real apply() calls. Without this guard, init's dummy token
             # would occupy slot 0 and every later step would be off by one.
             is_initialized = self.has_variable("cache", "cached_key")
+            # Rolling buffer under a sliding window: position p lives in
+            # slot p % L with L = window, so the cache holds exactly the
+            # last `window` positions — decode memory is O(window), not
+            # O(decode_len) (the Mistral rolling-cache recipe). Without a
+            # window, L = decode_len and slots are positions (slot = idx).
+            cache_len = (min(cfg.decode_len, cfg.attn_window)
+                         if cfg.attn_window else cfg.decode_len)
             ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, kv_heads, cfg.decode_len, d_head),
+                               (b, kv_heads, cache_len, d_head),
                                cfg.dtype)
             cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, kv_heads, cfg.decode_len, d_head),
+                               (b, kv_heads, cache_len, d_head),
                                cfg.dtype)
             ci = self.variable("cache", "cache_index",
                                lambda: jnp.zeros((), jnp.int32))
@@ -177,17 +184,20 @@ class CausalSelfAttention(nn.Module):
             q = rope(q, pos, cfg.rope_theta)
             k = rope(k, pos, cfg.rope_theta)
             if is_initialized:
+                slot = jax.lax.rem(idx, jnp.int32(cache_len))
                 ck.value = jax.lax.dynamic_update_slice_in_dim(
-                    ck.value, k.astype(cfg.dtype), idx, axis=2)
+                    ck.value, k.astype(cfg.dtype), slot, axis=2)
                 cv.value = jax.lax.dynamic_update_slice_in_dim(
-                    cv.value, v.astype(cfg.dtype), idx, axis=2)
+                    cv.value, v.astype(cfg.dtype), slot, axis=2)
                 ci.value = idx + 1
-            valid = jnp.arange(cfg.decode_len) <= idx           # [L]
-            if cfg.attn_window:
-                # windowed decode: only the last `window` cached positions
-                valid = jnp.logical_and(
-                    valid, jnp.arange(cfg.decode_len) > idx - cfg.attn_window)
-            bias = jnp.where(valid, 0.0, -jnp.inf)               # [L]
+            # slot s currently holds position p_s = idx - ((idx - s) mod L):
+            # the newest position <= idx congruent to s. Valid iff p_s >= 0.
+            # This single formula covers both layouts — unwritten slots of
+            # the plain cache (s > idx) get p_s < 0, and a full rolling
+            # buffer keeps exactly the last L = window positions.
+            slots = jnp.arange(cache_len)
+            p_s = idx - jnp.remainder(idx - slots, cache_len)
+            bias = jnp.where(p_s >= 0, 0.0, -jnp.inf)            # [L]
             # Grouped attention straight against the un-expanded cache:
             # materializing expand_kv(cache) would re-read group x the cache
             # bytes per token per layer — the exact cost GQA removes. Query
