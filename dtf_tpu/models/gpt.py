@@ -63,6 +63,12 @@ class GPTConfig:
     #: halo attention (one neighbor-tail ppermute, no ring rotation);
     #: zigzag rejects windows (its permuted layout breaks locality).
     attn_window: int = 0
+    #: with attn_window > 0: every k-th layer (1-indexed) uses FULL causal
+    #: attention instead — the alternating local/global pattern that keeps
+    #: long-range paths while most layers pay O(T·window). 0 = all layers
+    #: windowed. Each decode layer sizes its own cache (window slots for
+    #: local layers, decode_len for global ones).
+    attn_global_every: int = 0
     #: every k-th block uses a Switch-MoE FFN (0 = all dense).
     moe_every: int = 0
     moe: moe_lib.MoeConfig = moe_lib.MoeConfig()
@@ -82,6 +88,17 @@ class GPTConfig:
             # a negative window silently masks EVERY key: all-zero outputs
             # on the dense path, all--inf softmax (NaN) in decode
             raise ValueError(f"attn_window={self.attn_window} must be >= 0")
+        if self.attn_global_every < 0:
+            raise ValueError(
+                f"attn_global_every={self.attn_global_every} must be >= 0")
+
+    def layer_window(self, layer: int) -> int:
+        """Effective sliding window for layer ``layer`` (0-indexed): 0 when
+        the layer is a designated global layer, else ``attn_window``."""
+        if (self.attn_window and self.attn_global_every
+                and (layer + 1) % self.attn_global_every == 0):
+            return 0
+        return self.attn_window
 
     @property
     def kv_heads_resolved(self) -> int:
@@ -126,6 +143,11 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
     mesh: Optional[Mesh]
+    #: effective sliding window for THIS layer (cfg.layer_window(i) — 0 on
+    #: designated global layers). No default on purpose: a call site that
+    #: forgets to thread it must fail loudly, not silently train
+    #: full-causal under a windowed config.
+    window: int
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
@@ -169,8 +191,8 @@ class CausalSelfAttention(nn.Module):
             # last `window` positions — decode memory is O(window), not
             # O(decode_len) (the Mistral rolling-cache recipe). Without a
             # window, L = decode_len and slots are positions (slot = idx).
-            cache_len = (min(cfg.decode_len, cfg.attn_window)
-                         if cfg.attn_window else cfg.decode_len)
+            cache_len = (min(cfg.decode_len, self.window)
+                         if self.window else cfg.decode_len)
             ck = self.variable("cache", "cached_key", jnp.zeros,
                                (b, kv_heads, cache_len, d_head),
                                cfg.dtype)
@@ -237,9 +259,9 @@ class CausalSelfAttention(nn.Module):
         # transient — the cache/params only ever hold kv_heads.
         k, v = expand_kv(k), expand_kv(v)
 
-        if cfg.attn_window and seq_sharded and impl == "zigzag":
+        if self.window and seq_sharded and impl == "zigzag":
             raise ValueError(
-                f"attn_window={cfg.attn_window} is not supported with "
+                f"attn_window={self.window} is not supported with "
                 "seq-sharded zigzag (the permuted layout breaks locality); "
                 "use attn_impl=ring — windowed seq sharding routes to halo "
                 "attention, which is already load-balanced")
@@ -248,28 +270,28 @@ class CausalSelfAttention(nn.Module):
                 out = att.zigzag_ring_attention_sharded(q, k, v, self.mesh)
             else:
                 out = att.dense_attention(q, k, v, causal=True,
-                                          window=cfg.attn_window)
+                                          window=self.window)
         elif impl == "ring":
-            if cfg.attn_window and seq_sharded:
+            if self.window and seq_sharded:
                 # windowed + seq-sharded: halo attention — one neighbor-
                 # tail ppermute instead of rotating every K/V shard
                 out = att.halo_attention_sharded(q, k, v, self.mesh,
-                                                 window=cfg.attn_window)
-            elif cfg.attn_window:
+                                                 window=self.window)
+            elif self.window:
                 # ring's own seq=1 fallback is windowless dense — route the
                 # window explicitly rather than silently train full-causal
                 out = att.dense_attention(q, k, v, causal=True,
-                                          window=cfg.attn_window)
+                                          window=self.window)
             else:
                 out = att.ring_attention_sharded(q, k, v, self.mesh,
                                                  causal=True)
         elif impl == "flash":
             out = fa.flash_attention_sharded(
-                q, k, v, self.mesh, causal=True, window=cfg.attn_window,
+                q, k, v, self.mesh, causal=True, window=self.window,
                 interpret=jax.default_backend() != "tpu")
         else:
             out = att.dense_attention(q, k, v, causal=True,
-                                      window=cfg.attn_window)
+                                      window=self.window)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], t, cfg.d_model)
         out = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
                        name="attn_out")(out)
@@ -280,13 +302,14 @@ class Block(nn.Module):
     cfg: GPTConfig
     mesh: Optional[Mesh]
     use_moe: bool
+    window: int  # no default — see CausalSelfAttention.window
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
-        x = x + CausalSelfAttention(cfg, self.mesh, name="attention")(
-            h, deterministic)
+        x = x + CausalSelfAttention(cfg, self.mesh, self.window,
+                                    name="attention")(h, deterministic)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:
             y = moe_lib.SwitchFFN(cfg.d_model, cfg.d_ff, cfg.moe,
@@ -318,8 +341,8 @@ class GPT(nn.Module):
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(cfg.layers):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-            x = block(cfg, self.mesh, use_moe, name=f"layer_{i}")(
-                x, deterministic)
+            x = block(cfg, self.mesh, use_moe, cfg.layer_window(i),
+                      name=f"layer_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="lm_head")(x)

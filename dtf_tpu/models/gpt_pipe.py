@@ -79,8 +79,11 @@ class GPTStage(nn.Module):
         if self.cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(self.n_layers):
-            x = block(self.cfg, None, False, name=f"block_{i}")(
-                x, True)
+            # all stage layers share cfg.attn_window (validate_pipe_cfg
+            # rejects attn_global_every: per-layer windows would make
+            # stages heterogeneous, which the stacked schedule can't hold)
+            x = block(self.cfg, None, False, self.cfg.attn_window,
+                      name=f"block_{i}")(x, True)
         return x
 
 
@@ -90,6 +93,12 @@ def validate_pipe_cfg(cfg: GPTConfig, n_stages: int, interleave_v: int = 1):
         raise ValueError(
             f"layers={cfg.layers} must divide into {n_stages} stages x "
             f"{interleave_v} chunks = {rows} rows")
+    if cfg.attn_global_every:
+        raise ValueError(
+            "attn_global_every (alternating local/global layers) is not "
+            "supported in the pipelined path: per-layer windows make "
+            "stages heterogeneous, which the stacked-stage schedule "
+            "cannot represent; use a uniform attn_window or no pipeline")
     if cfg.moe_every:
         raise ValueError(
             "MoE blocks cannot run inside the pipeline (sow crosses the "

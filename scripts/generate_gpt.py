@@ -32,6 +32,8 @@ flags.DEFINE_integer("kv_heads", 0, "grouped-query attention heads; must "
                      "match the trained config (0 = plain MHA)")
 flags.DEFINE_integer("attn_window", 0, "sliding-window size; must match "
                      "the trained config (0 = full causal)")
+flags.DEFINE_integer("attn_global_every", 0, "global-attention layer "
+                     "cadence; must match the trained config")
 flags.DEFINE_string("prompt", "", "comma-separated token ids; empty = a "
                     "fixed demo prompt")
 flags.DEFINE_integer("batch", 1, "decode batch size (prompt is broadcast)")
@@ -85,6 +87,7 @@ def main(argv):
     total = len(prompt_ids) + FLAGS.n_new
     cfg = dataclasses.replace(base, kv_heads=FLAGS.kv_heads or None,
                               attn_window=FLAGS.attn_window,
+                              attn_global_every=FLAGS.attn_global_every,
                               decode_len=total)
     model = gpt.GPT(cfg)
 

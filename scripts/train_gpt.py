@@ -37,6 +37,9 @@ flags.DEFINE_integer("attn_window", 0, "sliding-window attention: each "
                      "query sees the last N keys (0 = full causal). With "
                      "mesh_seq>1 this routes to halo attention (one "
                      "neighbor-tail ppermute); zigzag rejects windows")
+flags.DEFINE_integer("attn_global_every", 0, "with attn_window: every "
+                     "k-th layer uses full causal attention (alternating "
+                     "local/global; 0 = all layers windowed)")
 flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
                     "zigzag (load-balanced causal ring; needs mesh_seq>1)")
 flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
@@ -78,6 +81,7 @@ def main(argv):
                               remat=FLAGS.remat, attn_impl=FLAGS.attn_impl,
                               kv_heads=FLAGS.kv_heads or None,
                               attn_window=FLAGS.attn_window,
+                              attn_global_every=FLAGS.attn_global_every,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
     tx = optax.adamw(

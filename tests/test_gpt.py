@@ -329,6 +329,56 @@ def test_generate_with_rolling_window_cache():
     np.testing.assert_array_equal(pred[:, 3:-1], got[:, 4:])
 
 
+def test_gpt_global_every_restores_long_range_paths():
+    """Alternating local/global: with a global layer in the stack, tokens
+    OLDER than the window influence late logits again (pure-window models
+    provably can't at depth 1); flash and dense agree on the mixed config;
+    decode caches are per-layer sized (window slots local, decode_len
+    global) and decode matches the full forward."""
+    kw = dict(dtype=jnp.float32, attn_window=4, attn_global_every=2)
+    cfg = gpt.GPTConfig.tiny(**kw)             # layer0 local, layer1 global
+    assert cfg.layer_window(0) == 4 and cfg.layer_window(1) == 0
+    model, init_fn = gpt.make_init(cfg, seq_len=16)
+    variables = init_fn(jax.random.PRNGKey(0))
+    ids = jnp.asarray(data_batch(n=2)["input_ids"][:, :16])
+    base = model.apply(variables, ids)
+    ids2 = np.array(ids).copy()
+    ids2[:, 0] = (ids2[:, 0] + 1) % cfg.vocab_size
+    pert = model.apply(variables, jnp.asarray(ids2))
+    # the global layer carries token 0's change to position 15
+    assert float(jnp.max(jnp.abs(base[:, 15] - pert[:, 15]))) > 1e-6
+
+    cfg_f = gpt.GPTConfig.tiny(attn_impl="flash", **kw)
+    model_f, _ = gpt.make_init(cfg_f, seq_len=16)
+    np.testing.assert_allclose(np.asarray(base),
+                               np.asarray(model_f.apply(variables, ids)),
+                               rtol=1e-4, atol=1e-4)
+
+    cfg_dec = dataclasses.replace(cfg, decode_len=16)
+    model_dec = gpt.GPT(cfg_dec)
+    cache = model_dec.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32))["cache"]
+    assert cache["layer_0"]["attention"]["cached_key"].shape[2] == 4
+    assert cache["layer_1"]["attention"]["cached_key"].shape[2] == 16
+    got = []
+    for t in range(16):
+        logits, mut = model_dec.apply(
+            {"params": variables["params"], "cache": cache},
+            ids[:, t:t + 1], mutable=["cache"])
+        cache = mut["cache"]
+        got.append(logits[:, 0])
+    np.testing.assert_allclose(np.asarray(jnp.stack(got, axis=1)),
+                               np.asarray(base), rtol=2e-4, atol=2e-4)
+
+
+def test_gpt_global_every_rejected_in_pipeline():
+    from dtf_tpu.models import gpt_pipe
+
+    cfg = gpt.GPTConfig.tiny(attn_window=4, attn_global_every=2)
+    with pytest.raises(ValueError, match="attn_global_every"):
+        gpt_pipe.validate_pipe_cfg(cfg, 2)
+
+
 def test_gpt_window_flash_matches_dense():
     cfg_d = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="dense",
                                attn_window=8)
