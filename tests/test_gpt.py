@@ -495,6 +495,17 @@ def test_gpt_gqa_tp_matches_dp():
     np.testing.assert_allclose(l_dp, l_tp, rtol=2e-4)
 
 
+def test_gpt_gqa_sp_ring_matches_dp():
+    """GQA composes with ring context parallelism: expanded K/V ride the
+    ring and the sp losses match the dp run."""
+    cfg = gpt.GPTConfig.tiny(kv_heads=2)
+    mesh_dp = make_mesh(MeshConfig(data=8))
+    mesh_sp = make_mesh(MeshConfig(data=2, seq=4))
+    _, l_dp = run(mesh_dp, steps=3, cfg=cfg)
+    _, l_sp = run(mesh_sp, steps=3, cfg=cfg, sp=True)
+    np.testing.assert_allclose(l_dp, l_sp, rtol=8e-4)
+
+
 def test_gpt_gqa_validates_divisibility():
     # validation fires at config construction, not first trace
     with pytest.raises(ValueError, match="divide"):
