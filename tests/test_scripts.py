@@ -96,6 +96,38 @@ def test_gpt_train_then_generate_round_trip(tmp_path):
     assert any(ln.startswith("5,9,2,") for ln in gen_sampled.splitlines())
 
 
+@pytest.mark.parametrize("which", ["gpt", "bert", "widedeep"])
+def test_bench_lm_child_tiny_mode(which, tmp_path):
+    """The LM bench children normally execute only on the TPU; tiny-mode
+    CPU runs pin their code paths in CI so a regression can't surface for
+    the first time mid-benchmark on the chip."""
+    env = _env()
+    env["DTF_LM_WHICH"] = which
+    env["DTF_LM_TINY"] = "1"
+    env["DTF_LM_STEPS"] = "2"
+    if which == "widedeep":
+        env["DTF_LM_BATCH"] = "64"
+    elif which == "bert":
+        # tiny default (8) x grad_accum 2 -> microbatch 4, which the
+        # 8-device sim can't shard; the TPU target is a single chip
+        env["DTF_LM_BATCH"] = "32"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_lm.py"),
+         "--child"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    import json
+
+    rows = [json.loads(ln[len("BENCH_LM_ROW "):])
+            for ln in proc.stdout.splitlines()
+            if ln.startswith("BENCH_LM_ROW ")]
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["model"] == which and row["sec_per_step"] > 0
+    key = "tokens_per_sec" if which in ("gpt", "bert") else "examples_per_sec"
+    assert row[key] > 0
+
+
 def test_generate_rejects_sampling_flags_at_greedy(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "scripts", "generate_gpt.py"),
