@@ -237,6 +237,18 @@ def tpu_child():
            "block_k": min(fa.DEFAULT_BLOCK_K, t)}
     row["flash_fwd_s"] = round(scan_timed(fwd_step(flash), q, r_fwd), 6)
     row["flash_fwdbwd_s"] = round(scan_timed(fwdbwd_step(flash), q, r_bwd), 6)
+    if t >= 4096:
+        # sliding-window locality on chip: O(T·window) via grid-level block
+        # skip — the long-context claim the halo/window stack makes.
+        wn = 1024
+        flash_w = lambda q, k, v: fa.flash_attention(  # noqa: E731
+            q, k, v, causal=True, window=wn, interpret=False)
+        r_w = reps_for(4 * b * h * t * wn * d)
+        row["window"] = wn
+        row["flash_window_fwd_s"] = round(
+            scan_timed(fwd_step(flash_w), q, r_w), 6)
+        row["window_speedup"] = round(
+            row["flash_fwd_s"] / row["flash_window_fwd_s"], 3)
     if dense_ok:
         row["dense_fwd_s"] = round(scan_timed(fwd_step(dense), q, r_fwd), 6)
         row["dense_fwdbwd_s"] = round(
