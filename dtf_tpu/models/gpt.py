@@ -256,8 +256,13 @@ class CausalSelfAttention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
         # expand AFTER rope (rope on kv_heads is cheaper); the repeat is a
-        # transient — the cache/params only ever hold kv_heads.
-        k, v = expand_kv(k), expand_kv(v)
+        # transient — cache/params only ever hold kv_heads. The seq-sharded
+        # ring skips it entirely: ring_attention folds query groups into
+        # rows so the UNEXPANDED K/V ride the ring (group x less ICI).
+        ring_gqa = (impl == "ring" and seq_sharded and not self.window
+                    and group > 1)
+        if not ring_gqa:
+            k, v = expand_kv(k), expand_kv(v)
 
         if self.window and seq_sharded and impl == "zigzag":
             raise ValueError(

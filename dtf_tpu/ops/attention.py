@@ -97,6 +97,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     never run, cutting total causal FLOPs roughly in half at large ring
     sizes. (The cond predicate varies per device; that is fine because the
     skipped branch contains no collectives — the ppermutes stay outside.)
+
+    GQA: ``q`` may carry ``G × kv_heads`` heads against K/V with
+    ``kv_heads`` — query groups are folded into rows internally so the
+    UNEXPANDED K/V ride the ring (G× less ICI traffic than repeating
+    them before the shard_map).
     """
     n = jax.lax.axis_size(axis_name)
     idx = jax.lax.axis_index(axis_name)
@@ -106,7 +111,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         kv_mask = (q[:, 0, :, 0] * 0 + 1).astype(bool)        # [B,Tl], varying
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    q_pos = idx * t_l + jnp.arange(t_l)                       # global q rows
+    if h % k.shape[1]:
+        # validate before the group-1 shortcut: 3 q heads over 2 kv heads
+        # gives group==1 and would die in an opaque einsum shape error
+        raise ValueError(
+            f"q heads {h} not a multiple of kv heads {k.shape[1]}")
+    group = h // k.shape[1]                       # GQA: q heads per kv head
+    if group > 1:
+        # Fold query groups into rows so the UNEXPANDED K/V ride the ring
+        # (group x less ICI traffic than repeating them): head h = kv*g + j
+        # maps to kv-head kv, row block j. Scores/stats become
+        # [B, KVH, G*Tl(, Tk)] — the streaming-softmax math is shape-
+        # generic, only the causal q-position pattern must tile per group.
+        q = q.reshape(b, k.shape[1], group * t_l, d)
+
+    q_pos = jnp.tile(idx * t_l + jnp.arange(t_l), group)      # global q rows
 
     def fold(k_blk, v_blk, mask_blk, src, m, l, o):
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k_blk,
@@ -146,6 +165,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
             body, (k, v, kv_mask, m, l, o), jnp.arange(1, n))
     # l=0 rows are fully-masked (pad queries): output 0, excluded from loss.
     out = o / jnp.maximum(l, 1e-30)[..., None]
+    if group > 1:
+        out = out.reshape(b, h, t_l, out.shape[-1])
     return out.astype(q.dtype)
 
 
@@ -413,6 +434,10 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     seq_shards = mesh.shape.get("seq", 1)
     if seq_shards == 1:
+        if k.shape[1] != q.shape[1]:          # GQA: expand for the dense
+            rep = q.shape[1] // k.shape[1]    # fallback (no ring to save)
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
         bias = None
         if kv_mask is not None:
             bias = jnp.where(kv_mask[:, None, None, :], 0.0, -jnp.inf)

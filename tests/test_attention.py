@@ -256,3 +256,30 @@ def test_halo_attention_trivial_seq_axis_is_windowed_dense():
         np.asarray(att.halo_attention_sharded(q, k, v, mesh, window=7)),
         np.asarray(att.dense_attention(q, k, v, causal=True, window=7)),
         rtol=1e-6, atol=1e-6)
+
+
+def test_ring_attention_gqa_unexpanded_kv_matches_dense():
+    """GQA through the ring: q with 4 heads against UNEXPANDED 2-head K/V
+    (the group-folded rows ride the ring) == dense with repeated heads."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    b, t, d = 2, 32, 16
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q = jax.random.normal(ks[0], (b, 4, t, d), jnp.float32)
+    k = jax.random.normal(ks[1], (b, 2, t, d), jnp.float32)
+    v = jax.random.normal(ks[2], (b, 2, t, d), jnp.float32)
+    want = att.dense_attention(q, jnp.repeat(k, 2, axis=1),
+                               jnp.repeat(v, 2, axis=1), causal=True)
+    got = att.ring_attention_sharded(q, k, v, mesh, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # grads flow through the fold/unfold
+    g = jax.grad(lambda q, k, v: att.ring_attention_sharded(
+        q, k, v, mesh, causal=True).sum(), (0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda q, k, v: att.dense_attention(
+        q, jnp.repeat(k, 2, axis=1), jnp.repeat(v, 2, axis=1),
+        causal=True).sum(), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)

@@ -496,8 +496,9 @@ def test_gpt_gqa_tp_matches_dp():
 
 
 def test_gpt_gqa_sp_ring_matches_dp():
-    """GQA composes with ring context parallelism: expanded K/V ride the
-    ring and the sp losses match the dp run."""
+    """GQA composes with ring context parallelism: the UNEXPANDED K/V ride
+    the ring (query groups folded into rows — group x less ICI traffic)
+    and the sp losses match the dp run."""
     cfg = gpt.GPTConfig.tiny(kv_heads=2)
     mesh_dp = make_mesh(MeshConfig(data=8))
     mesh_sp = make_mesh(MeshConfig(data=2, seq=4))
