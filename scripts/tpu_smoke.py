@@ -22,9 +22,11 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 ARTIFACT = os.path.join(ROOT, "TPU_SMOKE.json")
 SENTINEL = "TPU_SMOKE_RESULT "
+# Probe-first budget (VERDICT r3 weak #1): fast-fail on a dead backend in
+# ~3.5 min instead of burning 3 x 600 s of child timeouts.
+TOTAL_BUDGET_S = float(os.environ.get("DTF_SMOKE_BUDGET_S", "900"))
+PROBE_TIMEOUT_S = 90
 CHILD_TIMEOUT_S = 600
-RETRIES = 3
-BACKOFF_S = 15
 
 
 def child():
@@ -174,16 +176,28 @@ def child():
 
 
 def main():
-    from _dtf_watchdog import child_argv, run_watchdogged
+    from _dtf_watchdog import Budget, child_argv, probe_backend, \
+        run_watchdogged
 
-    result, errors = run_watchdogged(
-        child_argv(os.path.abspath(__file__)),
-        lambda line: (json.loads(line[len(SENTINEL):])
-                      if line.startswith(SENTINEL) else None),
-        timeout_s=CHILD_TIMEOUT_S, retries=RETRIES, backoff_s=BACKOFF_S,
-        env=dict(os.environ))
-    if result is None:
-        result = {"ok": False, "error": "; ".join(errors)[:3000]}
+    budget = Budget(TOTAL_BUDGET_S)
+    backend, probe_errors = probe_backend(
+        timeout_s=min(PROBE_TIMEOUT_S, max(10.0, budget.remaining(10))),
+        retries=2, backoff_s=10, env=dict(os.environ))
+    if backend is None:
+        result = {"ok": False,
+                  "error": ("backend unavailable (probe failed): "
+                            + "; ".join(probe_errors))[:3000]}
+    else:
+        result, errors = run_watchdogged(
+            child_argv(os.path.abspath(__file__)),
+            lambda line: (json.loads(line[len(SENTINEL):])
+                          if line.startswith(SENTINEL) else None),
+            timeout_s=min(CHILD_TIMEOUT_S, max(60.0, budget.remaining(30))),
+            retries=1, backoff_s=0, env=dict(os.environ))
+        if result is None:
+            result = {"ok": False,
+                      "error": (f"probe OK (backend={backend}) but smoke "
+                                "child failed: " + "; ".join(errors))[:3000]}
     with open(ARTIFACT, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))

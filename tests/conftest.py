@@ -29,6 +29,22 @@ if (not is_cpu_sim(os.environ, 8)
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Persistent compilation cache: the suite's wall-clock is dominated by
+# recompiling identical 8-device shard_map graphs every run (VERDICT r3
+# weak #5). With the cache, a warm full-pyramid run spends seconds where a
+# cold one spends minutes. Safe across code edits — the cache key hashes
+# the HLO, not the Python source.
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+@pytest.fixture(scope="session")
+def cpu_sim_subprocess_env():
+    """A scrubbed, CPU-pinned env for subprocess children (probe/bench
+    tests) — no axon vars, 1 virtual device (fast import)."""
+    return cpu_sim_env(1, os.environ)
+
 
 @pytest.fixture(scope="session")
 def mesh8():
