@@ -402,23 +402,29 @@ def filter_logits(logits: jax.Array, *, top_k: int = 0,
                   top_p: float = 1.0) -> jax.Array:
     """Top-k / nucleus (top-p) filtering: disallowed logits become -inf.
 
-    Static shapes throughout (one sort + thresholds, no gather of a dynamic
+    Static shapes throughout (sorts + thresholds, no gather of a dynamic
     count), so it jits and vmaps cleanly inside the decode scan. ``top_k=0``
     and ``top_p=1.0`` are no-ops; the highest-probability token is always
     kept. k-filter applies first, then the nucleus is computed over the
-    k-survivors (the standard sequential-warper composition). Callers
-    should pass ALREADY-TEMPERED logits (logits/temperature) so the
-    nucleus reflects the distribution actually sampled — ``generate``
-    does.
+    k-survivors (the standard sequential-warper composition). Exactly k
+    tokens survive the k-filter — ties at the k-th logit are broken by
+    token index (lower index wins), matching sorted-order semantics rather
+    than keeping every tied token. Callers should pass ALREADY-TEMPERED
+    logits (logits/temperature) so the nucleus reflects the distribution
+    actually sampled — ``generate`` does.
     """
     if top_k <= 0 and top_p >= 1.0:
         return logits
     vocab = logits.shape[-1]
-    desc = jnp.sort(logits, axis=-1)[..., ::-1]   # one sort serves both
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]   # serves the top-p pass
     if top_k > 0:
         k = min(top_k, vocab)
-        logits = jnp.where(logits < desc[..., k - 1][..., None],
-                           -jnp.inf, logits)
+        # rank via double argsort (stable ⇒ ties broken by token index);
+        # a plain `logits < desc[k-1]` threshold would keep EVERY token
+        # tied with the k-th largest (ADVICE r3)
+        order = jnp.argsort(-logits, axis=-1)
+        ranks = jnp.argsort(order, axis=-1)       # 0 = largest logit
+        logits = jnp.where(ranks < k, logits, -jnp.inf)
         desc = jnp.where(jnp.arange(vocab) < k, desc, -jnp.inf)
     if top_p < 1.0:
         probs = jax.nn.softmax(desc, axis=-1)     # -inf rows contribute 0

@@ -367,12 +367,21 @@ def halo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # this exists for. Chunk rows p ∈ [i·c, i·c+c) attend kk slice
     # [i·c, i·c+c+halo) (kk index j ↔ global k position idx·t - halo + j),
     # so live memory is O(c·(c+halo)) per (b, h) and chunks run under
-    # lax.map. c must divide t; the largest divisor ≤ q_chunk is used.
-    c = t if t <= q_chunk else max(
-        div for div in range(1, q_chunk + 1) if t % div == 0)
+    # lax.map. When c doesn't divide t, q and kk/vv are zero-padded to the
+    # next multiple and the pad rows sliced off afterwards (ADVICE r3: the
+    # old largest-divisor rule degraded to c=1 — one query row per lax.map
+    # step — for prime t). Pad rows stay NaN-free: each one's "diagonal"
+    # key exists in the padded kk (diff==0 is always kept), and every
+    # padded KEY sits at a global position strictly after the shard's real
+    # queries, so causality (diff >= 0) masks it for all real rows.
+    c = min(q_chunk, t)
+    pad = -t % c
+    q_p = jnp.pad(q, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else q
+    kk = jnp.pad(kk, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else kk
+    vv = jnp.pad(vv, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else vv
 
     def chunk(i):
-        qs = jax.lax.dynamic_slice_in_dim(q, i * c, c, axis=2)
+        qs = jax.lax.dynamic_slice_in_dim(q_p, i * c, c, axis=2)
         ks_ = jax.lax.dynamic_slice_in_dim(kk, i * c, c + halo, axis=2)
         vs_ = jax.lax.dynamic_slice_in_dim(vv, i * c, c + halo, axis=2)
         q_pos = idx * t + i * c + jnp.arange(c)          # global positions
@@ -386,10 +395,11 @@ def halo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         p = jax.nn.softmax(s, axis=-1)                   # diag always live
         return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), vs_)
 
-    if c == t:
-        return chunk(0)
-    out = jax.lax.map(chunk, jnp.arange(t // c))         # [n_c,b,h,c,d]
-    return out.transpose(1, 2, 0, 3, 4).reshape(b, h, t, d)
+    if c == t + pad:
+        return chunk(0)[:, :, :t]
+    out = jax.lax.map(chunk, jnp.arange((t + pad) // c))  # [n_c,b,h,c,d]
+    return out.transpose(1, 2, 0, 3, 4).reshape(
+        b, h, t + pad, d)[:, :, :t]
 
 
 def halo_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
@@ -443,6 +453,15 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
             bias = jnp.where(kv_mask[:, None, None, :], 0.0, -jnp.inf)
         return dense_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                                bias=bias)
+    model_shards = mesh.shape.get("model", 1)
+    if k.shape[1] % model_shards:
+        # the GQA ring keeps K/V unexpanded, so the head spec shards the
+        # kv_heads dim directly; an indivisible count would otherwise
+        # surface as an opaque GSPMD shape error (ADVICE r3)
+        raise ValueError(
+            f"kv_heads={k.shape[1]} must be divisible by the 'model' mesh "
+            f"axis ({model_shards}) to ring unexpanded GQA K/V; adjust "
+            "kv_heads or the mesh")
     spec = P("data", "model", "seq", None)
     mask_spec = P("data", "seq")
     fn = functools.partial(ring_attention, causal=causal, sm_scale=sm_scale)

@@ -258,6 +258,43 @@ def test_halo_attention_trivial_seq_axis_is_windowed_dense():
         rtol=1e-6, atol=1e-6)
 
 
+def test_halo_attention_prime_shard_pads_instead_of_row_at_a_time():
+    """ADVICE r3: prime t_local used to degrade the chunk size to c=1 (one
+    query row per lax.map step). Now the rows are padded to a q_chunk
+    multiple and sliced off — parity and NaN-free grads prove the pad rows
+    never leak."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    b, h, t, d = 2, 2, 52, 8           # t_local = 13 (prime); q_chunk=4
+    ks = jax.random.split(jax.random.PRNGKey(5), 3)
+    q, k, v = (jax.random.normal(kk, (b, h, t, d), jnp.float32) for kk in ks)
+    want = att.dense_attention(q, k, v, causal=True, window=5)
+    got = att.halo_attention_sharded(q, k, v, mesh, window=5, q_chunk=4)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    gw = jax.grad(lambda q, k, v: att.dense_attention(
+        q, k, v, causal=True, window=5).sum(), (0, 1, 2))(q, k, v)
+    gg = jax.grad(lambda q, k, v: att.halo_attention_sharded(
+        q, k, v, mesh, window=5, q_chunk=4).sum(), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(gg, gw):
+        assert np.isfinite(np.asarray(a)).all()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_sharded_validates_kv_head_divisibility():
+    """ADVICE r3: kv_heads not divisible by the model axis must raise the
+    clear message, not an opaque GSPMD shape error."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+    q = jnp.zeros((2, 4, 32, 8))
+    kv = jnp.zeros((2, 3, 32, 8))      # 3 kv heads, model=2
+    with pytest.raises(ValueError, match="divisible"):
+        att.ring_attention_sharded(q, kv, kv, mesh, causal=True)
+
+
 def test_ring_attention_gqa_unexpanded_kv_matches_dense():
     """GQA through the ring: q with 4 heads against UNEXPANDED 2-head K/V
     (the group-folded rows ride the ring) == dense with repeated heads."""

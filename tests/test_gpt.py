@@ -257,6 +257,18 @@ def test_filter_logits_top_k_and_top_p():
                                   np.asarray(logits))
 
 
+def test_filter_logits_top_k_exact_under_ties():
+    """ADVICE r3: ties at the k-th logit must not inflate the survivor set
+    — exactly k survive, lowest token index winning the tie."""
+    uniform = jnp.zeros((2, 8))
+    k1 = np.asarray(gpt.filter_logits(uniform, top_k=1))
+    assert (np.isfinite(k1).sum(axis=-1) == 1).all()
+    assert np.isfinite(k1[:, 0]).all()          # stable: index 0 wins
+    k3 = np.asarray(gpt.filter_logits(uniform, top_k=3))
+    assert (np.isfinite(k3).sum(axis=-1) == 3).all()
+    assert np.isfinite(k3[:, :3]).all()
+
+
 def test_generate_top_k1_equals_greedy():
     """Sampling at any temperature with top_k=1 collapses to greedy."""
     cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=24)
