@@ -95,6 +95,49 @@ def _block_live(i, j, *, causal, window, block_q, block_k):
     return live
 
 
+def _kv_sticky_map(*, causal, window, block_q, block_k, num_k):
+    """k/v BlockSpec index map for grids iterating (b, i, j): on DEAD
+    (i, j) tiles — skipped by ``pl.when(_block_live)`` — point the DMA at
+    the q-block's DIAGONAL k-block instead of the dead j. Mosaic elides
+    refetches when consecutive steps map to the same block, so dead tiles
+    stop burning HBM bandwidth on k/v copies nobody reads (the bundled
+    jax flash kernel's trick). The diagonal block is always live: it
+    contains a diff==0 position, in-window for any window >= 1."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def imap(b, i, j):
+        diag = jnp.minimum((i * block_q + block_q - 1) // block_k,
+                           num_k - 1)
+        live = _block_live(i, j, causal=causal, window=window,
+                           block_q=block_q, block_k=block_k)
+        return b, jax.lax.select(live, j, diag), 0
+
+    return imap
+
+
+def _q_sticky_map(*, causal, window, block_q, block_k, num_q, rank4=False):
+    """q/do/lse/delta index map for the dkv grid (b, j, i): dead tiles
+    point at k-block j's diagonal q-block (ceil((j·bk - bq + 1)/bq),
+    computed via the floor identity). Same DMA-elision rationale as
+    :func:`_kv_sticky_map`."""
+    if not causal:
+        if rank4:
+            return lambda b, j, i: (b, i, 0, 0)
+        return lambda b, j, i: (b, i, 0)
+
+    def imap(b, j, i):
+        diag = jnp.minimum((j * block_k) // block_q, num_q - 1)
+        live = _block_live(i, j, causal=causal, window=window,
+                           block_q=block_q, block_k=block_k)
+        i_eff = jax.lax.select(live, i, diag)
+        if rank4:
+            return b, i_eff, 0, 0
+        return b, i_eff, 0
+
+    return imap
+
+
 def _zero_padded_q_rows(p, i, *, block_q, t_q):
     """Zero p on padded query rows (their lse is -inf ⇒ exp overflows)."""
     if (t_q % block_q) == 0:
@@ -196,17 +239,19 @@ def _fwd(q, k, v, mask_bias, *, sm_scale, causal, window, block_q, block_k,
         _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k,
         has_mask=has_mask)
+    kv_map = _kv_sticky_map(causal=causal, window=window, block_q=block_q,
+                            block_k=block_k, num_k=num_k)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), kv_map),
+        pl.BlockSpec((1, block_k, d), kv_map),
     ]
     inputs = [qp, kp, vp]
     if has_mask:
         heads = bh // mask_bias.shape[0]  # bias rows are per-batch
         in_specs.append(
             pl.BlockSpec((1, 1, block_k),
-                         lambda b, i, j: (b // heads, 0, j)))
+                         lambda b, i, j: (b // heads, 0, kv_map(b, i, j)[1])))
         inputs.append(mask_bias)
     out, lse = pl.pallas_call(
         kern,
@@ -344,6 +389,8 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, window,
         return ([pl.BlockSpec((1, 1, block_k), index_map)]
                 if has_mask else [])
 
+    kv_map = _kv_sticky_map(causal=causal, window=window, block_q=block_q,
+                            block_k=block_k, num_k=num_k)
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, sm_scale=sm_scale, causal=causal, window=window,
@@ -352,12 +399,12 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, window,
         grid=(bh, num_q, num_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j: (b, i, 0, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j: (b, i, 0, 0)),
-        ] + mask_spec(lambda b, i, j: (b // heads, 0, j)),
+        ] + mask_spec(lambda b, i, j: (b // heads, 0, kv_map(b, i, j)[1])),
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
@@ -365,6 +412,10 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, window,
         interpret=interpret,
     )(qp, kp, vp, dop, lsep, deltap, *mask_in)
 
+    q_map = _q_sticky_map(causal=causal, window=window, block_q=block_q,
+                          block_k=block_k, num_q=num_q)
+    q_map4 = _q_sticky_map(causal=causal, window=window, block_q=block_q,
+                           block_k=block_k, num_q=num_q, rank4=True)
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, sm_scale=sm_scale, causal=causal, window=window,
@@ -372,12 +423,12 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, window,
             has_mask=has_mask),
         grid=(bh, num_k, num_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, 1, block_q), lambda b, j, i: (b, i, 0, 0)),
-            pl.BlockSpec((1, 1, 1, block_q), lambda b, j, i: (b, i, 0, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, 1, 1, block_q), q_map4),
+            pl.BlockSpec((1, 1, 1, block_q), q_map4),
         ] + mask_spec(lambda b, j, i: (b // heads, 0, j)),
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -459,6 +510,15 @@ def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
     if mesh is None:
         return flash_attention(q, k, v, causal=causal, window=window,
                                kv_mask=kv_mask, interpret=interpret)
+    if mesh.shape.get("seq", 1) > 1:
+        # the in_specs below replicate the sequence dim, so forcing flash
+        # on a seq-sharded mesh would silently all-gather T and compute the
+        # whole attention redundantly on every seq shard (ADVICE r3) —
+        # reject explicitly, mirroring the zigzag+window rejection
+        raise ValueError(
+            "flash attention keeps the sequence whole per shard; on a mesh "
+            f"with seq={mesh.shape['seq']} use attn_impl='ring'/'zigzag' "
+            "(full causal) or the halo path (windowed) instead")
     spec = P("data", "model", None, None)
     if kv_mask is None:
         fn = functools.partial(flash_attention, causal=causal, window=window,

@@ -40,9 +40,14 @@ sys.path.insert(0, ROOT)
 ARTIFACT = os.path.join(ROOT, "ATTN_BENCH.json")
 SENTINEL = "ATTN_TPU_RESULT "
 TPU_CHILD_TIMEOUT_S = 900
+# Hard total budget for a tpu run (probe + all children): the round-3
+# failure mode was the tunnel dying MID-collection, after the probe would
+# have passed — every child then burned full retries (VERDICT r3 weak #1).
+TPU_TOTAL_BUDGET_S = float(os.environ.get("DTF_ATTN_BUDGET_S", "5400"))
 
 
-def _merge_artifact(section: str, payload: dict):
+def _read_artifact() -> dict:
+    """Guarded read; migrates the legacy (r2) top-level-cpu-rows layout."""
     data = {}
     if os.path.exists(ARTIFACT):
         try:
@@ -50,9 +55,13 @@ def _merge_artifact(section: str, payload: dict):
                 data = json.load(f)
         except (OSError, json.JSONDecodeError):
             data = {}
-        # legacy layout (r2): top-level cpu rows — move under cpu_sim
         if "rows" in data and "cpu_sim" not in data:
             data = {"cpu_sim": data}
+    return data
+
+
+def _merge_artifact(section: str, payload: dict):
+    data = _read_artifact()
     data[section] = payload
     with open(ARTIFACT, "w") as f:
         json.dump(data, f, indent=1)
@@ -169,6 +178,11 @@ def tpu_child():
 
     b, h, d = 2, 8, 128
     t = int(os.environ["DTF_ATTN_SEQ"])
+    # block-shape override for the MXU-roof sweep (VERDICT r3 #4): the
+    # 512x512 default is a diagnosis-driven guess; the sweep measures it
+    # against rectangular and larger shapes on the real chip.
+    blk_q = int(os.environ.get("DTF_ATTN_BQ", "0"))
+    blk_k = int(os.environ.get("DTF_ATTN_BK", "0"))
     # Carry feedback scale: o*EPS is >30 orders below 1-ulp of any O(1)
     # carry entry, so the add rounds away and the values are unchanged in
     # practice — but XLA cannot prove that, so the scan body stays live.
@@ -215,8 +229,13 @@ def tpu_child():
             return c + (dq + dk + dv) * EPS
         return step
 
+    blk_kw = {}
+    if blk_q:
+        blk_kw["block_q"] = blk_q
+    if blk_k:
+        blk_kw["block_k"] = blk_k
     flash = lambda q, k, v: fa.flash_attention(  # noqa: E731
-        q, k, v, causal=True, interpret=False)
+        q, k, v, causal=True, interpret=False, **blk_kw)
     dense = lambda q, k, v: att.dense_attention(  # noqa: E731
         q, k, v, causal=True)
 
@@ -233,8 +252,8 @@ def tpu_child():
     row = {"seq": t, "backend": jax.default_backend(), "b": b, "h": h,
            "d": d, "dtype": "bfloat16", "null_jit_s": round(null_s, 5),
            "reps_fwd": r_fwd, "reps_fwdbwd": r_bwd,
-           "block_q": min(fa.DEFAULT_BLOCK_Q, t),
-           "block_k": min(fa.DEFAULT_BLOCK_K, t)}
+           "block_q": min(blk_q or fa.DEFAULT_BLOCK_Q, t),
+           "block_k": min(blk_k or fa.DEFAULT_BLOCK_K, t)}
     row["flash_fwd_s"] = round(scan_timed(fwd_step(flash), q, r_fwd), 6)
     row["flash_fwdbwd_s"] = round(scan_timed(fwdbwd_step(flash), q, r_bwd), 6)
     if t >= 4096:
@@ -265,25 +284,59 @@ def tpu_child():
 
 
 def tpu_main():
-    from _dtf_watchdog import run_watchdogged
+    from _dtf_watchdog import Budget, probe_backend, run_budgeted_jobs
 
-    rows, errs_all = [], []
-    for t in (1024, 2048, 4096, 8192, 16384, 32768):
-        env = dict(os.environ)
-        env["DTF_ATTN_SEQ"] = str(t)
-        row, errors = run_watchdogged(
-            [sys.executable, os.path.abspath(__file__), "tpu", "--child"],
-            lambda line: (json.loads(line[len(SENTINEL):])
-                          if line.startswith(SENTINEL) else None),
-            timeout_s=TPU_CHILD_TIMEOUT_S, retries=2, backoff_s=15, env=env)
-        if row is None:
-            errs_all.append({"seq": t, "errors": errors})
-        else:
-            rows.append(row)
-        # incremental write: partial progress survives a later hang
-        result = {"backend": "tpu", "rows": rows, "errors": errs_all}
-        _merge_artifact("tpu", result)
-        print(json.dumps(row if row is not None else errs_all[-1]))
+    budget = Budget(TPU_TOTAL_BUDGET_S)
+    # fast-fail on a dead tunnel before committing to 6 x 900 s of children
+    backend, probe_errors = probe_backend(env=dict(os.environ))
+    if backend is None:
+        # append the outage to the tpu section WITHOUT wiping rows already
+        # measured (the pre-outage evidence PERF.md §3c preserves)
+        err = {"probe": ("backend unavailable: "
+                         + "; ".join(probe_errors))[:2000]}
+        tpu = _read_artifact().get("tpu", {})
+        tpu.setdefault("errors", []).append(err)
+        _merge_artifact("tpu", tpu)
+        print(json.dumps(err))
+        return 1
+
+    argv = [sys.executable, os.path.abspath(__file__), "tpu", "--child"]
+    parse = lambda line: (json.loads(line[len(SENTINEL):])  # noqa: E731
+                          if line.startswith(SENTINEL) else None)
+
+    if "--sweep-blocks" in sys.argv:
+        # MXU-roof block-shape search (VERDICT r3 #4) at the headline seq:
+        # square vs rectangular vs larger blocks, one child each.
+        jobs = [{"DTF_ATTN_SEQ": "8192", "DTF_ATTN_BQ": str(bq),
+                 "DTF_ATTN_BK": str(bk)}
+                for bq, bk in ((256, 256), (512, 512), (512, 1024),
+                               (1024, 512), (1024, 1024), (512, 2048))]
+
+        def on_result(row, job, rows, errs):
+            tpu = _read_artifact().get("tpu", {})
+            tpu["block_sweep"] = {"rows": rows, "errors": errs}
+            _merge_artifact("tpu", tpu)
+            print(json.dumps(row if row is not None else errs[-1]))
+
+        rows, errs = run_budgeted_jobs(
+            jobs, argv, parse, budget=budget, cap_s=TPU_CHILD_TIMEOUT_S,
+            env_base=dict(os.environ), on_result=on_result)
+        return 0 if rows else 1
+
+    jobs = [{"DTF_ATTN_SEQ": str(t)}
+            for t in (1024, 2048, 4096, 8192, 16384, 32768)]
+
+    def on_result(row, job, rows, errs):
+        # incremental write: partial progress survives a later hang; the
+        # update preserves sibling keys (block_sweep) in the tpu section
+        tpu = _read_artifact().get("tpu", {})
+        tpu.update(backend="tpu", rows=rows, errors=errs)
+        _merge_artifact("tpu", tpu)
+        print(json.dumps(row if row is not None else errs[-1]))
+
+    rows, errs = run_budgeted_jobs(
+        jobs, argv, parse, budget=budget, cap_s=TPU_CHILD_TIMEOUT_S,
+        env_base=dict(os.environ), on_result=on_result)
     return 0 if rows else 1
 
 

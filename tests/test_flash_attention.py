@@ -199,3 +199,15 @@ def test_sm_scale_override():
     out = _flash(q, k, v, sm_scale=0.5)
     ref = dense_attention(q, k, v, sm_scale=0.5)
     np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_flash_sharded_rejects_seq_mesh():
+    """ADVICE r3: forcing flash on a seq-sharded mesh would silently
+    all-gather the sequence per shard — must raise, pointing at ring/halo."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.ops.flash_attention import flash_attention_sharded
+
+    mesh = make_mesh(MeshConfig(data=2, seq=2, model=2))
+    q = jnp.zeros((2, 2, 64, 32))
+    with pytest.raises(ValueError, match="seq"):
+        flash_attention_sharded(q, q, q, mesh, causal=True)
