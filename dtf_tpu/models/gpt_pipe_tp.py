@@ -245,6 +245,28 @@ def make_pipe_tp_loss(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
     return loss_fn
 
 
+def make_pipe_tp_eval(cfg: GPTConfig, n_stages: int):
+    """Held-out eval for the TP-in-pipe layout (VERDICT r3 #7): stages
+    applied sequentially with ``tp_axis=None`` on the stacked params —
+    identical math to :func:`make_sequential_tp_loss`; GSPMD moves the
+    P('pipe', …, 'model') rows as needed (eval is off the critical path)."""
+    per_row = validate_pipe_cfg(cfg, n_stages, 1)
+
+    def eval_fn(params, extra, batch):
+        del extra
+        p = params["params"] if "params" in params else params
+        x = GPTEmbed(cfg).apply({"params": p["embed"]}, batch["input_ids"])
+        for s in range(n_stages):
+            row = jax.tree.map(lambda t: t[s], p["stages"])
+            x = apply_stage(cfg, None, per_row, row, x)
+        logits = GPTHead(cfg).apply({"params": p["head"]}, x)
+        loss, _ = softmax_cross_entropy(logits, batch["labels"],
+                                        ignore_index=-100)
+        return {"eval_loss": loss, "eval_ppl": jnp.exp(loss)}
+
+    return eval_fn
+
+
 def make_sequential_tp_loss(cfg: GPTConfig, n_stages: int):
     """Parity oracle: the same block functions with ``tp_axis=None`` on the
     full params, stages applied in order — identical math, no mesh."""
