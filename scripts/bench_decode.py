@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Serving-path benchmark: batched greedy decode tokens/sec (VERDICT r3 #5).
+
+The decode stack (``dtf_tpu/models/gpt.py: generate``) ships two memory
+levers whose perf claims previously had no numbers:
+
+- **GQA** (``kv_heads < heads``): the cache shrinks by heads/kv_heads and
+  each decode step reads group x fewer cache bytes — decode is HBM-bound,
+  so this should show up directly in tokens/sec.
+- **rolling window cache** (``attn_window``): O(window) slots instead of
+  O(decode_len) — smaller cache reads per step past the window.
+
+Grid: GPT-2 small, batch 8, prompt 128, +512 new tokens — MHA vs GQA
+(kv_heads=4) x full vs rolling (window=256) cache. One config per
+watchdogged child (axon-hang isolation); a probe fast-fails a dead tunnel
+(~3.5 min). Rows merge into ``BENCH_LM.json`` under ``"decode"`` without
+touching the training rows.
+
+Timing: the whole generate() scan is ONE dispatch over the tunnel (~639
+sequential steps), so the ~75 ms round trip is noise — no scan-folding
+needed (contrast scripts/bench_attention.py tpu_child).
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+ARTIFACT = os.path.join(ROOT, "BENCH_LM.json")
+SENTINEL = "BENCH_DECODE_ROW "
+CHILD_TIMEOUT_S = 900
+TOTAL_BUDGET_S = float(os.environ.get("DTF_DECODE_BUDGET_S", "4500"))
+
+
+def child():
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from dtf_tpu.models import gpt
+
+    tiny = os.environ.get("DTF_DECODE_TINY") == "1"
+    kv_heads = int(os.environ.get("DTF_DEC_KV", "0")) or None
+    window = int(os.environ.get("DTF_DEC_WINDOW", "0"))
+    if tiny:
+        b, t_p, n_new = 2, 8, 8
+        base = gpt.GPTConfig.tiny(dtype=jax.numpy.bfloat16)
+    else:
+        b, t_p, n_new = 8, 128, 512
+        base = gpt.GPTConfig.gpt2_small()
+    total = t_p + n_new
+    cfg = dataclasses.replace(base, decode_len=total, kv_heads=kv_heads,
+                              attn_window=window)
+    model = gpt.GPT(cfg, None)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jax.numpy.zeros((b, 1), jax.numpy.int32))
+    params = variables["params"]
+    rng = np.random.default_rng(0)
+    prompt = jax.numpy.asarray(
+        rng.integers(0, cfg.vocab_size, (b, t_p)).astype(np.int32))
+
+    run = jax.jit(lambda p, ids: gpt.generate(model, p, ids, n_new))
+    out = jax.block_until_ready(run(params, prompt))     # compile + warm
+    assert out.shape == (b, total)
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run(params, prompt))
+        ts.append(time.perf_counter() - t0)
+    dt = statistics.median(ts)
+
+    kvh = cfg.kv_heads_resolved
+    cache_len = min(total, window) if window else total
+    d_head = cfg.d_model // cfg.heads
+    cache_bytes = 2 * b * kvh * cache_len * d_head * 2 * cfg.layers  # K+V bf16
+    row = {
+        "model": ("gpt_tiny" if tiny else "gpt2_small") + "_decode",
+        "backend": jax.default_backend(),
+        "batch": b, "prompt": t_p, "n_new": n_new,
+        "kv_heads": kvh, "heads": cfg.heads, "window": window,
+        "cache_mib": round(cache_bytes / 2**20, 2),
+        "sec_total": round(dt, 4),
+        # every scan step emits one token per sequence (prompt steps are
+        # teacher-forced single-token decode steps too)
+        "decode_tokens_per_sec": round(b * (total - 1) / dt, 1),
+        "ms_per_step": round(dt / (total - 1) * 1e3, 3),
+    }
+    print(SENTINEL + json.dumps(row))
+
+
+def _read() -> dict:
+    try:
+        with open(ARTIFACT) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+
+
+def _merge(rows, errors):
+    data = _read()
+    data["decode"] = {"rows": rows, "errors": errors}
+    with open(ARTIFACT, "w") as f:
+        json.dump(data, f, indent=1)
+
+
+def main():
+    from _dtf_watchdog import Budget, child_argv, probe_backend, \
+        run_budgeted_jobs
+
+    budget = Budget(TOTAL_BUDGET_S)
+    backend, probe_errors = probe_backend(env=dict(os.environ))
+    if backend is None:
+        # append the outage; keep any previously measured decode rows
+        err = {"probe": ("backend unavailable: "
+                         + "; ".join(probe_errors))[:2000]}
+        data = _read()
+        data.setdefault("decode", {}).setdefault("errors", []).append(err)
+        with open(ARTIFACT, "w") as f:
+            json.dump(data, f, indent=1)
+        print(json.dumps(err))
+        return 1
+    jobs = [  # MHA vs GQA x full vs rolling-window cache
+        {"DTF_DEC_KV": "0", "DTF_DEC_WINDOW": "0"},
+        {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "0"},
+        {"DTF_DEC_KV": "0", "DTF_DEC_WINDOW": "256"},
+        {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "256"},
+    ]
+
+    def on_result(row, job, rows, errors):
+        _merge(rows, errors)
+        print(json.dumps(row if row is not None else errors[-1]))
+
+    rows, errors = run_budgeted_jobs(
+        jobs, child_argv(os.path.abspath(__file__)),
+        lambda line: (json.loads(line[len(SENTINEL):])
+                      if line.startswith(SENTINEL) else None),
+        budget=budget, cap_s=CHILD_TIMEOUT_S, env_base=dict(os.environ),
+        on_result=on_result)
+    return 0 if rows and not errors else 1
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        sys.exit(main())

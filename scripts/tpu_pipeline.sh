@@ -1,0 +1,13 @@
+#!/bin/sh
+# On-chip artifact pipeline (PERF.md §3c) — run the moment a chip is
+# reachable. Every step is probe-first + budget-capped, so a tunnel that
+# dies mid-pipeline costs minutes per step and leaves structured errors.
+set -x
+cd "$(dirname "$0")/.." || exit 1
+python scripts/tpu_smoke.py
+python scripts/bench_attention.py tpu
+python scripts/bench_attention.py tpu --sweep-blocks
+python scripts/bench_lm.py
+python scripts/bench_lm.py --sweep-gpt
+python scripts/bench_decode.py
+python bench.py
