@@ -149,6 +149,33 @@ class CausalSelfAttention(nn.Module):
     #: full-causal under a windowed config.
     window: int
 
+    def _cache_vars(self, b: int, kv_heads: int, d_head: int):
+        """The KV-cache collection — ONE definition shared by the
+        single-token decode branch and the prefill write, so their layouts
+        cannot desynchronize. Rolling buffer under a sliding window:
+        position p lives in slot p % L with L = window, so the cache holds
+        exactly the last `window` positions — decode memory is O(window),
+        not O(decode_len) (the Mistral rolling-cache recipe). Without a
+        window, L = decode_len and slots are positions (slot = idx).
+
+        Standard flax decode idiom: init() only ALLOCATES the cache
+        (has_variable is False on the init trace, so no slot is written
+        and cache_index stays 0); mutation happens only on real apply()
+        calls. Without this guard, init's dummy token would occupy slot 0
+        and every later step would be off by one.
+        """
+        cfg = self.cfg
+        is_initialized = self.has_variable("cache", "cached_key")
+        cache_len = (min(cfg.decode_len, self.window)
+                     if self.window else cfg.decode_len)
+        ck = self.variable("cache", "cached_key", jnp.zeros,
+                           (b, kv_heads, cache_len, d_head), cfg.dtype)
+        cv = self.variable("cache", "cached_value", jnp.zeros,
+                           (b, kv_heads, cache_len, d_head), cfg.dtype)
+        ci = self.variable("cache", "cache_index",
+                           lambda: jnp.zeros((), jnp.int32))
+        return ck, cv, ci, cache_len, is_initialized
+
     @nn.compact
     def __call__(self, x, deterministic: bool):
         cfg = self.cfg
@@ -173,34 +200,21 @@ class CausalSelfAttention(nn.Module):
             # s's repeated kv heads).
             return jnp.repeat(a, group, axis=1) if group > 1 else a
 
-        if cfg.decode_len > 0:
+        if cfg.decode_len > 0 and t != 1:
+            # PREFILL: the whole prompt in one causal forward (parallel,
+            # MXU-shaped) instead of t sequential single-token steps. The
+            # attention math is the ordinary full-sequence path below; the
+            # only decode-specific work is the one-shot cache write, which
+            # happens after rope (the cache stores roped K). Must be the
+            # FIRST cache-mutating call (cache_index is assumed 0, matching
+            # generate()'s usage); decode then continues token-by-token.
+            pass  # falls through to the full-sequence path
+        elif cfg.decode_len > 0:
             # KV-cache decode: one token in, attend against all cached
             # positions <= idx. Cache layout [B, H, L, D] matches training.
-            if t != 1:
-                raise ValueError(
-                    f"decode mode takes one token per call, got T={t}")
             b = x.shape[0]
-            # Standard flax decode idiom: init() only ALLOCATES the cache
-            # (has_variable is False on the init trace, so no slot is
-            # written and cache_index stays 0); mutation happens only on
-            # real apply() calls. Without this guard, init's dummy token
-            # would occupy slot 0 and every later step would be off by one.
-            is_initialized = self.has_variable("cache", "cached_key")
-            # Rolling buffer under a sliding window: position p lives in
-            # slot p % L with L = window, so the cache holds exactly the
-            # last `window` positions — decode memory is O(window), not
-            # O(decode_len) (the Mistral rolling-cache recipe). Without a
-            # window, L = decode_len and slots are positions (slot = idx).
-            cache_len = (min(cfg.decode_len, self.window)
-                         if self.window else cfg.decode_len)
-            ck = self.variable("cache", "cached_key", jnp.zeros,
-                               (b, kv_heads, cache_len, d_head),
-                               cfg.dtype)
-            cv = self.variable("cache", "cached_value", jnp.zeros,
-                               (b, kv_heads, cache_len, d_head),
-                               cfg.dtype)
-            ci = self.variable("cache", "cache_index",
-                               lambda: jnp.zeros((), jnp.int32))
+            ck, cv, ci, cache_len, is_initialized = self._cache_vars(
+                b, kv_heads, d_head)
             idx = ci.value
             pos = idx[None]
             q = rope(q, pos, cfg.rope_theta)
@@ -255,6 +269,33 @@ class CausalSelfAttention(nn.Module):
             positions = jnp.arange(t)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+        if cfg.decode_len > 0:
+            # prefill cache write: the last min(L, t) roped-K / V rows land
+            # at their rolling slots (slot = pos % L, same layout the
+            # single-token branch maintains) and cache_index advances by t.
+            # K/V are still UNexpanded here — the cache holds kv_heads.
+            ck, cv, ci, cache_len, is_initialized = self._cache_vars(
+                x.shape[0], kv_heads, d_head)
+            # One-shot prefill only: rope used positions 0..t-1 and the
+            # slot math below assumes the sequence starts at 0, so a
+            # multi-token apply on an ALREADY-ADVANCED cache would corrupt
+            # it. The index is traced under jit (generate() upholds the
+            # invariant by construction there), but eager misuse is caught.
+            if (is_initialized
+                    and not isinstance(ci.value, jax.core.Tracer)
+                    and int(ci.value) != 0):
+                raise ValueError(
+                    "multi-token decode apply needs an EMPTY cache (one-"
+                    "shot prefill); chunked prefill after decode has "
+                    "started is not supported")
+            if is_initialized:
+                keep = min(cache_len, t)
+                slots = jnp.remainder(jnp.arange(t - keep, t), cache_len)
+                ck.value = ck.value.at[:, :, slots, :].set(
+                    k[:, :, t - keep:, :].astype(cfg.dtype))
+                cv.value = cv.value.at[:, :, slots, :].set(
+                    v[:, :, t - keep:, :].astype(cfg.dtype))
+                ci.value = ci.value + t
         # expand AFTER rope (rope on kv_heads is cheaper); the repeat is a
         # transient — cache/params only ever hold kv_heads. The seq-sharded
         # ring skips it entirely: ring_attention folds query groups into
@@ -441,14 +482,16 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
              temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0,
              mesh: Optional[Mesh] = None) -> jax.Array:
-    """Autoregressive decode with the KV cache, as one ``lax.scan``.
+    """Autoregressive decode: one-pass prefill + a single-token ``lax.scan``.
 
     ``model.cfg.decode_len`` must cover prompt+new tokens. ``prompt``
     [B, T_p] int32; returns [B, T_p + n_new]. Greedy when temperature==0,
     else temperature sampling with optional ``top_k`` / nucleus ``top_p``
-    filtering (:func:`filter_logits`). The whole loop is jittable: the
-    cache is scan-carried state, one token per step — the standard TPU
-    decode shape.
+    filtering (:func:`filter_logits`). The prompt is PREFILLED in one
+    parallel causal forward that writes the KV cache (MXU-shaped work,
+    not T_p sequential steps); generation is then a jittable scan with the
+    cache as carried state, one token per step — the standard TPU serving
+    shape.
 
     ``mesh``: shard the decode — the KV cache lands P('data','model')
     (batch over data shards, heads over TP shards; see
@@ -459,6 +502,8 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     cfg = model.cfg
     b, t_p = prompt.shape
     total = t_p + n_new
+    if n_new < 1:
+        raise ValueError(f"n_new={n_new} must be >= 1")
     if cfg.decode_len < total:
         raise ValueError(
             f"decode_len={cfg.decode_len} < prompt+new={total}")
@@ -497,13 +542,7 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
         cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                               shapes["cache"])
 
-    def body(carry, t):
-        cache, tok, rng = carry
-        logits, mut = model.apply(
-            {"params": params, "cache": cache}, tok[:, None],
-            deterministic=True, mutable=["cache"])
-        nxt_logits = logits[:, 0]
-        rng, sub = jax.random.split(rng)
+    def pick(nxt_logits, sub):
         if temperature > 0.0:
             # temper FIRST so the nucleus is built from the distribution
             # actually sampled (the standard warper ordering).
@@ -512,19 +551,30 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
             nxt = jax.random.categorical(sub, filtered, -1)
         else:
             nxt = jnp.argmax(nxt_logits, -1)
-        nxt = nxt.astype(jnp.int32)
-        # teacher-force while still inside the prompt
-        in_prompt = t + 1 < t_p
-        tok_next = jnp.where(in_prompt,
-                             jax.lax.dynamic_index_in_dim(
-                                 prompt, jnp.minimum(t + 1, t_p - 1), 1,
-                                 keepdims=False),
-                             nxt)
-        return (mut["cache"], tok_next, rng), tok_next
+        return nxt.astype(jnp.int32)
+
+    # PREFILL: the whole prompt in one parallel causal forward that also
+    # writes the KV cache (see CausalSelfAttention's prefill branch) —
+    # t_p MXU-shaped steps collapse into one, vs the old token-by-token
+    # teacher-forced loop.
+    logits, mut = model.apply({"params": params, "cache": cache0}, prompt,
+                              deterministic=True, mutable=["cache"])
+    rng, sub = jax.random.split(rng)
+    tok0 = pick(logits[:, -1], sub)
+
+    def body(carry, _):
+        cache, tok, rng = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok[:, None],
+            deterministic=True, mutable=["cache"])
+        rng, sub = jax.random.split(rng)
+        nxt = pick(logits[:, 0], sub)
+        return (mut["cache"], nxt, rng), nxt
 
     (_, _, _), toks = jax.lax.scan(
-        body, (cache0, prompt[:, 0], rng), jnp.arange(total - 1))
-    out = jnp.concatenate([prompt[:, :1], toks.T.astype(jnp.int32)], axis=1)
+        body, (mut["cache"], tok0, rng), None, length=n_new - 1)
+    out = jnp.concatenate(
+        [prompt, tok0[:, None], toks.T.astype(jnp.int32)], axis=1)
     return out
 
 

@@ -160,7 +160,13 @@ def test_bench_decode_child_tiny_mode(kv, window):
             if ln.startswith("BENCH_DECODE_ROW ")]
     assert len(rows) == 1
     row = rows[0]
-    assert row["decode_tokens_per_sec"] > 0
+    assert row["prefill_tokens_per_sec"] > 0
+    # tiny-mode decode deltas may be inside dispatch noise — then the row
+    # must say so instead of carrying a nonsense number
+    if row.get("decode_noise_limited"):
+        assert row["decode_tokens_per_sec"] is None
+    else:
+        assert row["decode_tokens_per_sec"] > 0
     assert row["kv_heads"] == (int(kv) or 4) and row["window"] == int(window)
 
 
