@@ -68,7 +68,8 @@ def child():
         state, shardings = tr.create_train_state(
             init_fn, tx, jax.random.PRNGKey(0), mesh,
             param_rules=bert.tp_rules, zero1=True)
-        step = tr.make_train_step(bert.make_loss(model), tx, mesh, shardings,
+        loss_fn = bert.make_loss(model)
+        step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   grad_accum=accum, log_grad_norm=False)
         data = shard_batch(
             SyntheticData("bert", batch, seed=0, seq_len=seq,
@@ -90,7 +91,8 @@ def child():
         state, shardings = tr.create_train_state(
             init_fn, tx, jax.random.PRNGKey(0), mesh,
             param_rules=gpt.tp_rules, zero1=True)
-        step = tr.make_train_step(gpt.make_loss(model), tx, mesh, shardings,
+        loss_fn = gpt.make_loss(model)
+        step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   log_grad_norm=False)
         data = shard_batch(
             SyntheticData("gpt", batch, seed=0, seq_len=seq,
@@ -107,7 +109,8 @@ def child():
         state, shardings = tr.create_train_state(
             widedeep.make_init(model), tx, jax.random.PRNGKey(0), mesh,
             param_rules=widedeep.rules)
-        step = tr.make_train_step(widedeep.make_loss(model), tx, mesh,
+        loss_fn = widedeep.make_loss(model)
+        step = tr.make_train_step(loss_fn, tx, mesh,
                                   shardings, log_grad_norm=False)
         rng = np.random.default_rng(0)
         data = shard_batch(
@@ -118,9 +121,49 @@ def child():
                    n_params=int(_count_params(state.params)))
         unit_scale = batch  # examples per step
 
-    # XLA's own per-step cost
+    # Phase decomposition for MFU attribution (PERF.md §3c): time the
+    # forward alone / forward+backward alone instead of the full step, so
+    # a low measured MFU can be pinned to fwd math, bwd math, or the
+    # optimizer+update tail by subtraction across three child runs.
+    phase = os.environ.get("DTF_LM_PHASE", "step")
+    if phase in ("fwd", "fwdbwd"):
+        import jax.numpy as jnp
+
+        rng0 = jax.random.PRNGKey(0)
+        if phase == "fwd":
+            timed = jax.jit(
+                lambda s, b: loss_fn(s.params, s.extra, b, rng0)[0])
+        else:
+            def fwdbwd(s, b):
+                (loss, _), grads = jax.value_and_grad(
+                    lambda p: loss_fn(p, s.extra, b, rng0),
+                    has_aux=True)(s.params)
+                # grads must feed the output or XLA dead-code-eliminates
+                # the entire backward; 1e-30 keeps them live at zero
+                # numeric effect (same trick as bench_attention's scan)
+                gsum = sum(jnp.sum(jnp.abs(g).astype(jnp.float32))
+                           for g in jax.tree.leaves(grads))
+                return loss + 1e-30 * gsum
+
+            timed = jax.jit(fwdbwd)
+        row["phase"] = phase
+
+        def run():
+            return timed(state, data)
+    else:
+
+        def run():
+            nonlocal state
+            state, metrics = step(state, data)
+            return metrics["loss"]
+
+    # XLA's own cost for whatever is being timed (step or phase graph);
+    # MFU fields divide these flops by the measured time, so they must
+    # describe the SAME computation the timing loop runs.
     try:
-        cost = step.lower(state, data).compile().cost_analysis()
+        lowered = (timed.lower(state, data) if phase != "step"
+                   else step.lower(state, data))
+        cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
         row["xla_flops_per_step"] = float(cost.get("flops", 0.0))
@@ -129,27 +172,29 @@ def child():
         row["cost_error"] = repr(e)[:300]
 
     for _ in range(3):
-        state, metrics = step(state, data)
-    float(metrics["loss"])
+        out = run()
+    float(out)
     n_steps = int(os.environ.get("DTF_LM_STEPS", "10"))
     t0 = time.perf_counter()
     for _ in range(n_steps):
-        state, metrics = step(state, data)
-    float(metrics["loss"])
+        out = run()
+    float(out)  # device executes the queue serially; one readback fences
     dt = time.perf_counter() - t0
 
     per_sec = unit_scale * n_steps / dt
     row["sec_per_step"] = round(dt / n_steps, 5)
     if which in ("bert", "gpt"):
         row["tokens_per_sec"] = round(per_sec, 1)
-        # analytic: 6 FLOPs per param per token (fwd+bwd, weight FLOPs) +
-        # attention 12*L*d*s per token
-        layers = cfg.layers
-        width = cfg.hidden if which == "bert" else cfg.d_model
-        att = 12 * layers * width * row["seq"]
-        flops_tok = 6 * row["n_params"] + att
-        row["mfu_analytic"] = round(
-            per_sec * flops_tok / V5E_PEAK_BF16_FLOPS, 4)
+        if phase == "step":
+            # analytic: 6 FLOPs per param per token (fwd+bwd, weight
+            # FLOPs) + attention 12*L*d*s per token — a FULL-step flop
+            # model, so only the full-step timing may be divided by it
+            layers = cfg.layers
+            width = cfg.hidden if which == "bert" else cfg.d_model
+            att = 12 * layers * width * row["seq"]
+            flops_tok = 6 * row["n_params"] + att
+            row["mfu_analytic"] = round(
+                per_sec * flops_tok / V5E_PEAK_BF16_FLOPS, 4)
     else:
         row["examples_per_sec"] = round(per_sec, 1)
     if "xla_flops_per_step" in row:
@@ -185,6 +230,12 @@ def main():
         jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b)}
                 for b in (8, 16, 32, 64)]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
+    elif "--phases-gpt" in sys.argv:
+        # fwd / fwd+bwd / full-step decomposition: pins a low MFU on fwd
+        # math, bwd math, or the optimizer tail by subtraction.
+        jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_PHASE": p}
+                for p in ("fwd", "fwdbwd", "step")]
+        artifact = os.path.join(ROOT, "BENCH_LM_PHASES.json")
     else:
         jobs = [{"DTF_LM_WHICH": "bert"}, {"DTF_LM_WHICH": "widedeep"},
                 {"DTF_LM_WHICH": "gpt"}]

@@ -9,5 +9,6 @@ python scripts/bench_attention.py tpu
 python scripts/bench_attention.py tpu --sweep-blocks
 python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
+python scripts/bench_lm.py --phases-gpt
 python scripts/bench_decode.py
 python bench.py

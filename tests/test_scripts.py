@@ -141,6 +141,31 @@ def test_bench_lm_child_tiny_mode(which, tmp_path):
     assert row[key] > 0
 
 
+def test_bench_lm_phase_child_tiny_mode():
+    """CI-pin the fwd/fwdbwd phase-decomposition children: the backward
+    must stay live in the timed graph (its XLA flop count must be well
+    above the forward's), or the MFU attribution run would silently time
+    a dead-code-eliminated graph."""
+    import json
+
+    flops = {}
+    for phase in ("fwd", "fwdbwd"):
+        env = _env()
+        env.update(DTF_LM_WHICH="gpt", DTF_LM_TINY="1", DTF_LM_STEPS="2",
+                   DTF_LM_PHASE=phase)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(ROOT, "scripts", "bench_lm.py"),
+             "--child"],
+            env=env, capture_output=True, text=True, timeout=420)
+        assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+        row = next(json.loads(ln[len("BENCH_LM_ROW "):])
+                   for ln in proc.stdout.splitlines()
+                   if ln.startswith("BENCH_LM_ROW "))
+        assert row["phase"] == phase and row["tokens_per_sec"] > 0
+        flops[phase] = row.get("xla_flops_per_step", 0.0)
+    assert flops["fwdbwd"] > 2.0 * flops["fwd"]
+
+
 @pytest.mark.parametrize("kv,window", [("0", "0"), ("2", "8")])
 def test_bench_decode_child_tiny_mode(kv, window):
     """CI-pin the decode benchmark children (MHA/full and GQA/rolling
