@@ -257,6 +257,35 @@ def test_filter_logits_top_k_and_top_p():
                                   np.asarray(logits))
 
 
+def test_prefill_cache_matches_token_by_token():
+    """One-pass prefill must leave the KV cache (rolling slots, per-layer
+    sizes under the alternating local/global config) and the last-position
+    logits EXACTLY as t single-token decode steps would."""
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_window=4,
+                             attn_global_every=2, decode_len=16)
+    model = gpt.GPT(cfg)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1), jnp.int32))
+    params = variables["params"]
+    prompt = jnp.asarray(data_batch(n=2)["input_ids"][:, :7])  # 7 > window
+
+    cache = variables["cache"]
+    for t in range(7):
+        logits_t, mut = model.apply({"params": params, "cache": cache},
+                                    prompt[:, t:t + 1], mutable=["cache"])
+        cache = mut["cache"]
+
+    cache0 = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((2, 1), jnp.int32))["cache"]
+    logits_p, mut_p = model.apply({"params": params, "cache": cache0},
+                                  prompt, mutable=["cache"])
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5),
+        cache, mut_p["cache"])
+    np.testing.assert_allclose(np.asarray(logits_p[:, -1]),
+                               np.asarray(logits_t[:, 0]),
+                               rtol=1e-4, atol=1e-4)
+
+
 def test_filter_logits_top_k_exact_under_ties():
     """ADVICE r3: ties at the k-th logit must not inflate the survivor set
     — exactly k survive, lowest token index winning the tie."""
