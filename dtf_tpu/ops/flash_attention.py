@@ -210,6 +210,68 @@ def _fwd_kernel(q_ref, k_ref, v_ref, *rest, sm_scale, causal, window,
         lse_ref[0, 0, 0, :] = lse[:, 0]
 
 
+def _fwd_kernel_hfold(q_ref, k_ref, v_ref, *rest, sm_scale, causal, window,
+                      block_q, block_k, num_k, t_q, t_k, has_mask):
+    """Head-folded forward: the grid's bh dim advances ``block_h`` heads per
+    step, so one grid step runs block_h batched [bq,d]x[d,bk] MXU
+    contractions back-to-back — amortizing the fixed per-step overhead
+    (PERF.md §3 measured ~1 us/step vs sub-us of matmul work at d=128) by
+    the fold factor. Separate from :func:`_fwd_kernel` on purpose: the 2-D
+    kernel is the on-chip-proven default; this one is opt-in
+    (``block_h > 1``) until the block sweep measures it.
+
+    Same math as the 2-D kernel with a leading head axis [h, ...]: the
+    positional/causal masks are head-independent and numpy-broadcast
+    against [h, bq, bk] scores; softmax stats carry an extra leading dim.
+    """
+    mb_ref = rest[0] if has_mask else None
+    o_ref, lse_ref, m_scr, l_scr, acc_scr = rest[1:] if has_mask else rest
+    i, j = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, m_scr.dtype)
+        l_scr[...] = jnp.zeros(l_scr.shape, l_scr.dtype)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, acc_scr.dtype)
+
+    run = _block_live(i, j, causal=causal, window=window,
+                      block_q=block_q, block_k=block_k)
+
+    @pl.when(run)
+    def _block():
+        q, k = q_ref[...], k_ref[...]            # [h, bq, d], [h, bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * sm_scale   # [h, bq, bk]
+        s = _score_mask(s, i, j, causal=causal, block_q=block_q,
+                        block_k=block_k, t_k=t_k, window=window)
+        if has_mask:
+            # every folded head shares the batch row (block_h | heads is
+            # enforced by the wrapper)
+            s = s + mb_ref[0, 0][None, None, :]
+        m_prev = m_scr[:, :, 0:1]                # [h, bq, 1]
+        l_prev = l_scr[:, :, 0:1]
+        m_next = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
+        m_safe = jnp.where(m_next == _NEG_INF, 0.0, m_next)
+        alpha = jnp.exp(m_prev - m_safe)
+        p = jnp.exp(s - m_safe)
+        l_next = alpha * l_prev + jnp.sum(p, axis=2, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[...], (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)  # [h, bq, d]
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+
+    @pl.when(j == num_k - 1)
+    def _finalize():
+        l = l_scr[:, :, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[...] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        m = m_scr[:, :, 0:1]
+        lse = jnp.where(l == 0.0, _NEG_INF, m + jnp.log(l_safe))
+        lse_ref[:, 0, 0, :] = lse[:, :, 0]
+
+
 def _mask_bias(kv_mask, b, t_k, block_k):
     """[b, 1, t_k_padded] f32 additive bias: 0 valid, -inf padded key.
 
@@ -225,7 +287,7 @@ def _mask_bias(kv_mask, b, t_k, block_k):
 
 
 def _fwd(q, k, v, mask_bias, *, sm_scale, causal, window, block_q, block_k,
-         interpret):
+         interpret, block_h=1):
     bh, t_q, d = q.shape
     t_k = k.shape[1]
     num_q = pl.cdiv(t_q, block_q)
@@ -236,40 +298,48 @@ def _fwd(q, k, v, mask_bias, *, sm_scale, causal, window, block_q, block_k,
     has_mask = mask_bias is not None
 
     kern = functools.partial(
-        _fwd_kernel, sm_scale=sm_scale, causal=causal, window=window,
+        _fwd_kernel_hfold if block_h > 1 else _fwd_kernel,
+        sm_scale=sm_scale, causal=causal, window=window,
         block_q=block_q, block_k=block_k, num_k=num_k, t_q=t_q, t_k=t_k,
         has_mask=has_mask)
     kv_map = _kv_sticky_map(causal=causal, window=window, block_q=block_q,
                             block_k=block_k, num_k=num_k)
     in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, d), kv_map),
-        pl.BlockSpec((1, block_k, d), kv_map),
+        pl.BlockSpec((block_h, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((block_h, block_k, d), kv_map),
+        pl.BlockSpec((block_h, block_k, d), kv_map),
     ]
     inputs = [qp, kp, vp]
     if has_mask:
         heads = bh // mask_bias.shape[0]  # bias rows are per-batch
+        # folded index b covers heads [b*block_h, (b+1)*block_h) — one
+        # batch row serves them all (wrapper enforces block_h | heads)
         in_specs.append(
             pl.BlockSpec((1, 1, block_k),
-                         lambda b, i, j: (b // heads, 0, kv_map(b, i, j)[1])))
+                         lambda b, i, j: (b * block_h // heads, 0,
+                                          kv_map(b, i, j)[1])))
         inputs.append(mask_bias)
     out, lse = pl.pallas_call(
         kern,
-        grid=(bh, num_q, num_k),
+        grid=(bh // block_h, num_q, num_k),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, 1, block_q), lambda b, i, j: (b, i, 0, 0)),
+            pl.BlockSpec((block_h, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((block_h, 1, 1, block_q),
+                         lambda b, i, j: (b, i, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(qp.shape, q.dtype),
             jax.ShapeDtypeStruct((bh, num_q, 1, block_q), jnp.float32),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((block_q, d), jnp.float32),
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((block_h, block_q, _STAT_LANES), jnp.float32),
+             pltpu.VMEM((block_h, block_q, _STAT_LANES), jnp.float32),
+             pltpu.VMEM((block_h, block_q, d), jnp.float32)]
+            if block_h > 1 else
+            [pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+             pltpu.VMEM((block_q, _STAT_LANES), jnp.float32),
+             pltpu.VMEM((block_q, d), jnp.float32)]),
         compiler_params=_compiler_params(("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*inputs)
@@ -462,25 +532,26 @@ def _pad(x, multiple, axis):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, mask_bias, causal, window, sm_scale, block_q, block_k,
-           interpret):
+           interpret, block_h):
     out, _ = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
                   window=window, block_q=block_q, block_k=block_k,
-                  interpret=interpret)
+                  interpret=interpret, block_h=block_h)
     return out
 
 
 def _flash_fwd(q, k, v, mask_bias, causal, window, sm_scale, block_q,
-               block_k, interpret):
+               block_k, interpret, block_h):
     out, lse = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
                     window=window, block_q=block_q, block_k=block_k,
-                    interpret=interpret)
+                    interpret=interpret, block_h=block_h)
     return out, (q, k, v, mask_bias, out, lse)
 
 
-def _flash_bwd(causal, window, sm_scale, block_q, block_k, interpret, res,
-               do):
+def _flash_bwd(causal, window, sm_scale, block_q, block_k, interpret,
+               block_h, res, do):
+    del block_h  # fwd-only lever; the backward keeps the proven 2-D grids
     q, k, v, mask_bias, out, lse = res
     dq, dk, dv = _bwd(q, k, v, mask_bias, out, lse, do, sm_scale=sm_scale,
                       causal=causal, window=window, block_q=block_q,
@@ -542,6 +613,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     sm_scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
+                    block_h: int = 1,
                     interpret: bool = False) -> jax.Array:
     """Fused attention. [B, H, T, D] → [B, H, T, D]; differentiable.
 
@@ -556,6 +628,11 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     ``window > 0`` (requires ``causal``): sliding-window locality — query t
     attends keys in (t-window, t]. Blocks entirely outside the window are
     SKIPPED at the grid level, so compute is O(T·window) not O(T²/2).
+
+    ``block_h > 1`` (opt-in): fold that many heads into each forward grid
+    step — batched MXU contractions amortize the fixed per-step overhead
+    (see :func:`_fwd_kernel_hfold`). Must divide ``heads``. Forward only;
+    the backward keeps its proven 2-D grids.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, H, T, D], got shape {q.shape}")
@@ -564,6 +641,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"window={window} must be >= 0 and requires causal=True")
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
+    if block_h < 1 or h % block_h:
+        raise ValueError(f"block_h={block_h} must be >= 1 and divide "
+                         f"heads={h}")
     scale = float(sm_scale) if sm_scale is not None else d ** -0.5
     block_q = min(block_q, max(t_q, 1))
     block_k = min(block_k, max(t_k, 1))
@@ -577,5 +657,5 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 f"kv_mask shape {kv_mask.shape} != (batch, t_k)=({b}, {t_k})")
         mask_bias = _mask_bias(kv_mask, b, t_k, block_k)
     out = _flash(qr, kr, vr, mask_bias, causal, int(window), scale,
-                 block_q, block_k, interpret)
+                 block_q, block_k, interpret, int(block_h))
     return out.reshape(b, h, t_q, d)

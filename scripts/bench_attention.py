@@ -183,6 +183,10 @@ def tpu_child():
     # against rectangular and larger shapes on the real chip.
     blk_q = int(os.environ.get("DTF_ATTN_BQ", "0"))
     blk_k = int(os.environ.get("DTF_ATTN_BK", "0"))
+    blk_h = int(os.environ.get("DTF_ATTN_BH", "0"))  # head fold (fwd only)
+    # CPU CI pin: interpret-mode run of this exact child (tiny seq) so a
+    # wiring typo can't surface for the first time on the chip
+    interp = os.environ.get("DTF_ATTN_INTERPRET") == "1"
     # Carry feedback scale: o*EPS is >30 orders below 1-ulp of any O(1)
     # carry entry, so the add rounds away and the values are unchanged in
     # practice — but XLA cannot prove that, so the scan body stays live.
@@ -234,14 +238,18 @@ def tpu_child():
         blk_kw["block_q"] = blk_q
     if blk_k:
         blk_kw["block_k"] = blk_k
+    if blk_h:
+        blk_kw["block_h"] = blk_h
     flash = lambda q, k, v: fa.flash_attention(  # noqa: E731
-        q, k, v, causal=True, interpret=False, **blk_kw)
+        q, k, v, causal=True, interpret=interp, **blk_kw)
     dense = lambda q, k, v: att.dense_attention(  # noqa: E731
         q, k, v, causal=True)
 
     # reps: enough kernel FLOPs that the subtracted tunnel overhead is noise
     fwd_flops = 4 * b * h * t * t * d  # causal halves it; keep conservative
     def reps_for(flops):
+        if interp:
+            return 2  # CI wiring check, not a measurement
         return max(8, min(512, int(4e12 / flops)))
     r_fwd, r_bwd = reps_for(fwd_flops), reps_for(3.5 * fwd_flops)
 
@@ -253,7 +261,8 @@ def tpu_child():
            "d": d, "dtype": "bfloat16", "null_jit_s": round(null_s, 5),
            "reps_fwd": r_fwd, "reps_fwdbwd": r_bwd,
            "block_q": min(blk_q or fa.DEFAULT_BLOCK_Q, t),
-           "block_k": min(blk_k or fa.DEFAULT_BLOCK_K, t)}
+           "block_k": min(blk_k or fa.DEFAULT_BLOCK_K, t),
+           "block_h": blk_h or 1}
     row["flash_fwd_s"] = round(scan_timed(fwd_step(flash), q, r_fwd), 6)
     row["flash_fwdbwd_s"] = round(scan_timed(fwdbwd_step(flash), q, r_bwd), 6)
     if t >= 4096:
@@ -261,7 +270,7 @@ def tpu_child():
         # skip — the long-context claim the halo/window stack makes.
         wn = 1024
         flash_w = lambda q, k, v: fa.flash_attention(  # noqa: E731
-            q, k, v, causal=True, window=wn, interpret=False)
+            q, k, v, causal=True, window=wn, interpret=interp, **blk_kw)
         r_w = reps_for(4 * b * h * t * wn * d)
         row["window"] = wn
         row["flash_window_fwd_s"] = round(
@@ -308,9 +317,12 @@ def tpu_main():
         # MXU-roof block-shape search (VERDICT r3 #4) at the headline seq:
         # square vs rectangular vs larger blocks, one child each.
         jobs = [{"DTF_ATTN_SEQ": "8192", "DTF_ATTN_BQ": str(bq),
-                 "DTF_ATTN_BK": str(bk)}
-                for bq, bk in ((256, 256), (512, 512), (512, 1024),
-                               (1024, 512), (1024, 1024), (512, 2048))]
+                 "DTF_ATTN_BK": str(bk), "DTF_ATTN_BH": str(bh)}
+                for bq, bk, bh in (
+                    (256, 256, 1), (512, 512, 1), (512, 1024, 1),
+                    (1024, 512, 1), (1024, 1024, 1), (512, 2048, 1),
+                    # head folding (fwd): amortize per-grid-step overhead
+                    (512, 512, 2), (512, 512, 4), (1024, 1024, 2))]
 
         def on_result(row, job, rows, errs):
             tpu = _read_artifact().get("tpu", {})

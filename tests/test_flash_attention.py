@@ -211,3 +211,42 @@ def test_flash_sharded_rejects_seq_mesh():
     q = jnp.zeros((2, 2, 64, 32))
     with pytest.raises(ValueError, match="seq"):
         flash_attention_sharded(q, q, q, mesh, causal=True)
+
+
+@pytest.mark.parametrize("block_h", [2, 4])
+@pytest.mark.parametrize("causal,window", [(False, 0), (True, 0), (True, 24)])
+def test_hfold_forward_matches_dense(block_h, causal, window):
+    """Head-folded forward grid (block_h heads per step) == dense, across
+    full/causal/windowed and the padded-T path."""
+    b, h, t, d = 2, 4, 67, 16
+    q, k, v = (_rand((b, h, t, d), jnp.float32, 7 + i) for i in range(3))
+    out = _flash(q, k, v, causal=causal, window=window, block_h=block_h)
+    ref = dense_attention(q, k, v, causal=causal, window=window)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_hfold_kv_mask_and_grads():
+    """h-fold with the per-batch padding mask; grads route through the
+    (unchanged 2-D) backward."""
+    b, h, t, d = 2, 4, 64, 16
+    q, k, v = (_rand((b, h, t, d), jnp.float32, 20 + i) for i in range(3))
+    mask = np.ones((b, t), bool)
+    mask[0, 50:] = False
+    mask = jnp.asarray(mask)
+    bias = jnp.where(mask[:, None, None, :], 0.0, -jnp.inf)
+    out = _flash(q, k, v, kv_mask=mask, block_h=2)
+    ref = dense_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+    g = jax.grad(lambda q, k, v: _flash(
+        q, k, v, causal=True, block_h=2).sum(), (0, 1, 2))(q, k, v)
+    gw = jax.grad(lambda q, k, v: dense_attention(
+        q, k, v, causal=True).sum(), (0, 1, 2))(q, k, v)
+    for a, b_ in zip(g, gw):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_hfold_rejects_nondivisible():
+    q = jnp.zeros((2, 3, 32, 16))
+    with pytest.raises(ValueError, match="block_h"):
+        _flash(q, q, q, block_h=2)

@@ -141,6 +141,29 @@ def test_bench_lm_child_tiny_mode(which, tmp_path):
     assert row[key] > 0
 
 
+def test_bench_attention_tpu_child_interpret_mode():
+    """CI-pin the TPU attention-bench child (incl. the h-folded forward
+    grid) via its interpret-mode escape hatch — a wiring typo must not
+    surface for the first time on the chip."""
+    import json
+
+    env = _env()
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env.update(DTF_ATTN_SEQ="256", DTF_ATTN_BQ="64", DTF_ATTN_BK="64",
+               DTF_ATTN_BH="2", DTF_ATTN_INTERPRET="1")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "bench_attention.py"), "tpu",
+         "--child"],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    row = next(json.loads(ln[len("ATTN_TPU_RESULT "):])
+               for ln in proc.stdout.splitlines()
+               if ln.startswith("ATTN_TPU_RESULT "))
+    assert row["seq"] == 256 and row["block_h"] == 2
+    assert row["flash_fwd_s"] > 0 and row["flash_fwdbwd_s"] > 0
+
+
 def test_bench_lm_phase_child_tiny_mode():
     """CI-pin the fwd/fwdbwd phase-decomposition children: the backward
     must stay live in the timed graph (its XLA flop count must be well
