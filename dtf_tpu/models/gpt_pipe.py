@@ -68,6 +68,13 @@ class GPTStage(nn.Module):
     Activation-shape-preserving ([mb, T, d] → [mb, T, d]), the homogeneity
     the stacked-stage schedules require. Blocks run mesh-less (see module
     docstring); remat applies per block when ``cfg.remat``.
+
+    Per-layer windows (``attn_global_every``) are supported when the
+    local/global pattern's period divides ``n_layers``: every stage then
+    holds the SAME [window, ..., global] layer sequence, so the stacked
+    schedule's homogeneity is preserved (validate_pipe_cfg enforces the
+    divisibility). ``cfg.layer_window(i)`` is stage-offset-invariant in
+    that case because the pattern repeats with the period.
     """
 
     cfg: GPTConfig
@@ -79,10 +86,7 @@ class GPTStage(nn.Module):
         if self.cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(self.n_layers):
-            # all stage layers share cfg.attn_window (validate_pipe_cfg
-            # rejects attn_global_every: per-layer windows would make
-            # stages heterogeneous, which the stacked schedule can't hold)
-            x = block(self.cfg, None, False, self.cfg.attn_window,
+            x = block(self.cfg, None, False, self.cfg.layer_window(i),
                       name=f"block_{i}")(x, True)
         return x
 
@@ -93,12 +97,13 @@ def validate_pipe_cfg(cfg: GPTConfig, n_stages: int, interleave_v: int = 1):
         raise ValueError(
             f"layers={cfg.layers} must divide into {n_stages} stages x "
             f"{interleave_v} chunks = {rows} rows")
-    if cfg.attn_global_every:
+    if cfg.attn_global_every and (cfg.layers // rows) % cfg.attn_global_every:
         raise ValueError(
-            "attn_global_every (alternating local/global layers) is not "
-            "supported in the pipelined path: per-layer windows make "
-            "stages heterogeneous, which the stacked-stage schedule "
-            "cannot represent; use a uniform attn_window or no pipeline")
+            f"attn_global_every={cfg.attn_global_every} must divide the "
+            f"per-stage layer count ({cfg.layers // rows}) so every stage "
+            "holds the same local/global layer pattern (the stacked-stage "
+            "schedule requires homogeneous stages); adjust layers/stages "
+            "or the period")
     if cfg.moe_every:
         raise ValueError(
             "MoE blocks cannot run inside the pipeline (sow crosses the "
