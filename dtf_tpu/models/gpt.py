@@ -148,6 +148,12 @@ class CausalSelfAttention(nn.Module):
     #: forgets to thread it must fail loudly, not silently train
     #: full-causal under a windowed config.
     window: int
+    #: True when this module already runs INSIDE a shard_map manual over
+    #: the 'seq' axis (the PP x SP composition: pipeline stages carry
+    #: seq-sharded activations). RoPE positions then come from the axis
+    #: index and attention uses the per-shard ring/halo collectives
+    #: directly — a nested shard_map would be illegal here.
+    manual_seq: bool = False
 
     def _cache_vars(self, b: int, kv_heads: int, d_head: int):
         """The KV-cache collection — ONE definition shared by the
@@ -260,7 +266,10 @@ class CausalSelfAttention(nn.Module):
             else:
                 impl = "dense"
 
-        if impl == "zigzag" and seq_sharded:
+        if self.manual_seq:
+            # t is the LOCAL shard length; global positions via axis index
+            positions = jax.lax.axis_index("seq") * t + jnp.arange(t)
+        elif impl == "zigzag" and seq_sharded:
             # rows arrive in the zigzag layout (the data layer permuted
             # them; see zigzag_batch) — RoPE needs their GLOBAL positions,
             # which are exactly the permutation values.
@@ -300,8 +309,8 @@ class CausalSelfAttention(nn.Module):
         # transient — cache/params only ever hold kv_heads. The seq-sharded
         # ring skips it entirely: ring_attention folds query groups into
         # rows so the UNEXPANDED K/V ride the ring (group x less ICI).
-        ring_gqa = (impl == "ring" and seq_sharded and not self.window
-                    and group > 1)
+        ring_gqa = (((impl == "ring" and seq_sharded) or self.manual_seq)
+                    and not self.window and group > 1)
         if not ring_gqa:
             k, v = expand_kv(k), expand_kv(v)
 
@@ -311,7 +320,16 @@ class CausalSelfAttention(nn.Module):
                 "seq-sharded zigzag (the permuted layout breaks locality); "
                 "use attn_impl=ring — windowed seq sharding routes to halo "
                 "attention, which is already load-balanced")
-        if impl == "zigzag":
+        if self.manual_seq:
+            # PP x SP: per-shard collectives inside the enclosing manual
+            # context — windowed layers fetch one neighbor halo, full
+            # layers ride the ring (unexpanded GQA K/V). Falls through to
+            # the shared projection tail below.
+            if self.window:
+                out = att.halo_attention(q, k, v, window=self.window)
+            else:
+                out = att.ring_attention(q, k, v, causal=True)
+        elif impl == "zigzag":
             if seq_sharded:
                 out = att.zigzag_ring_attention_sharded(q, k, v, self.mesh)
             else:
@@ -349,12 +367,14 @@ class Block(nn.Module):
     mesh: Optional[Mesh]
     use_moe: bool
     window: int  # no default — see CausalSelfAttention.window
+    manual_seq: bool = False  # see CausalSelfAttention.manual_seq
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
         cfg = self.cfg
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + CausalSelfAttention(cfg, self.mesh, self.window,
+                                    manual_seq=self.manual_seq,
                                     name="attention")(h, deterministic)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:

@@ -8,11 +8,15 @@ homogeneous stages stacked along a leading row dim sharded ``P('pipe')``
 (GPipe) or interleaved Megatron-style (``interleave_v > 1``).
 
 Composition contract: inside the pipeline body we are already inside
-``shard_map`` (manual over `pipe` and `data`), so the blocks run with
-``mesh=None`` — dense or flash attention per shard, no nested TP/ring
-collectives. dp x pp is the supported product here; TP composes with the
-non-pipelined path (`dtf_tpu.models.gpt.tp_rules`). MoE-in-pipe is
-rejected explicitly (`sow` cannot cross the shard_map/scan boundary).
+``shard_map`` (manual over `pipe`, `data` — and `seq` under PP x SP), so
+the blocks run with ``mesh=None``: dense/flash attention per shard, or —
+when the mesh carries a non-trivial ``seq`` axis — the per-shard ring
+(halo for windowed layers) via ``manual_seq``, using the enclosing manual
+axes directly instead of a nested shard_map. dp x pp x sp is the
+supported product here; Megatron TP composes either with the
+non-pipelined path (`dtf_tpu.models.gpt.tp_rules`) or inside stages via
+`gpt_pipe_tp` (without sp). MoE-in-pipe is rejected explicitly (`sow`
+cannot cross the shard_map/scan boundary).
 
 Reference citation: the reference has no PP at all (SURVEY.md §2c marks it
 out of scope); this exists because a complete TPU framework needs layer
@@ -79,6 +83,11 @@ class GPTStage(nn.Module):
 
     cfg: GPTConfig
     n_layers: int
+    #: PP x SP: stage activations arrive seq-sharded inside the pipeline's
+    #: shard_map; attention then uses per-shard ring/halo collectives (see
+    #: CausalSelfAttention.manual_seq). Init must use manual_seq=False
+    #: (no axis context outside shard_map) — the params are identical.
+    manual_seq: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -87,11 +96,13 @@ class GPTStage(nn.Module):
             block = nn.remat(Block, static_argnums=(2,))
         for i in range(self.n_layers):
             x = block(self.cfg, None, False, self.cfg.layer_window(i),
+                      manual_seq=self.manual_seq,
                       name=f"block_{i}")(x, True)
         return x
 
 
-def validate_pipe_cfg(cfg: GPTConfig, n_stages: int, interleave_v: int = 1):
+def validate_pipe_cfg(cfg: GPTConfig, n_stages: int, interleave_v: int = 1,
+                      seq_shards: int = 1):
     rows = n_stages * interleave_v
     if cfg.layers % rows:
         raise ValueError(
@@ -116,12 +127,22 @@ def validate_pipe_cfg(cfg: GPTConfig, n_stages: int, interleave_v: int = 1):
             "deterministic inside the schedule); the non-pipelined path "
             "honors it — silently dropping regularization is worse than "
             "refusing")
-    if cfg.attn_impl in ("ring", "zigzag"):
+    if cfg.attn_impl == "zigzag":
         raise ValueError(
-            f"attn_impl={cfg.attn_impl!r} needs the seq mesh axis, but "
-            "pipeline stages run mesh-less (no nested collectives inside "
-            "shard_map); use dense/flash with mesh_pipe, or mesh_seq "
-            "without mesh_pipe")
+            "attn_impl='zigzag' is not supported with mesh_pipe (the "
+            "permuted data layout would have to thread through the "
+            "microbatch schedule); PP x SP uses the plain ring")
+    if seq_shards > 1:
+        if cfg.attn_impl not in ("auto", "ring"):
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} cannot run seq-sharded "
+                "inside pipeline stages; PP x SP routes auto/ring to "
+                "per-shard ring (halo when windowed)")
+    elif cfg.attn_impl == "ring":
+        raise ValueError(
+            "attn_impl='ring' needs the seq mesh axis, but pipeline "
+            "stages run mesh-less without it; use dense/flash with "
+            "mesh_pipe alone, or add mesh_seq (PP x SP)")
     return cfg.layers // rows
 
 
@@ -135,10 +156,11 @@ def make_pipe_init(cfg: GPTConfig, mesh: Mesh, *, seq_len: int = 128,
     :func:`dtf_tpu.parallel.pipeline.reorder_stages`.
     """
     n_stages = mesh.shape.get(axis_name, 1)
-    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v)
+    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v,
+                                mesh.shape.get("seq", 1))
     rows = n_stages * interleave_v
-    stage = GPTStage(cfg, per_row)
-    b = mesh.shape.get("data", 1)
+    stage = GPTStage(cfg, per_row)   # init runs OUTSIDE shard_map: no
+    b = mesh.shape.get("data", 1)    # manual_seq (params are identical)
 
     def init_fn(rng):
         r_e, r_s, r_h = jax.random.split(rng, 3)
@@ -164,20 +186,32 @@ def pipe_rules(axis_name: str = "pipe"):
 def make_pipe_loss(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
                    interleave_v: int = 1, axis_name: str = "pipe"):
     """Loss fn (make_train_step-compatible) running blocks through the
-    GPipe schedule (or the interleaved one when ``interleave_v > 1``)."""
+    GPipe schedule (or the interleaved one when ``interleave_v > 1``).
+
+    PP x SP: when the mesh has a non-trivial ``seq`` axis, microbatch
+    activations ride the schedule seq-sharded (batch_spec gains 'seq')
+    and the stages run ring/halo attention per shard
+    (:class:`GPTStage` ``manual_seq``)."""
     n_stages = mesh.shape.get(axis_name, 1)
-    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v)
-    stage = GPTStage(cfg, per_row)
+    seq_shards = mesh.shape.get("seq", 1)
+    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v, seq_shards)
+    sp = seq_shards > 1
+    stage = GPTStage(cfg, per_row, manual_seq=sp)
+    batch_spec = P("data", "seq") if sp else P("data")
 
     def stage_fn(stage_params, x):
         return stage.apply({"params": stage_params}, x)
 
     if interleave_v > 1:
         pipe = pp.pipeline_interleaved(stage_fn, n_microbatches, mesh,
-                                       interleave_v, axis_name=axis_name)
+                                       interleave_v, axis_name=axis_name,
+                                       batch_spec=batch_spec,
+                                       check_vma=not sp)
     else:
         pipe = pp.pipeline_spmd(stage_fn, n_microbatches, mesh,
-                                axis_name=axis_name)
+                                axis_name=axis_name,
+                                batch_spec=batch_spec,
+                                check_vma=not sp)
 
     def loss_fn(params, extra, batch, rng):
         del rng  # blocks run deterministic inside the schedule
@@ -192,7 +226,8 @@ def make_pipe_loss(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
     return loss_fn
 
 
-def make_pipe_eval(cfg: GPTConfig, n_stages: int, *, interleave_v: int = 1):
+def make_pipe_eval(cfg: GPTConfig, n_stages: int, *, interleave_v: int = 1,
+                   seq_shards: int = 1):
     """Held-out eval for the pipelined param layout (VERDICT r3 #7).
 
     The eval step runs UN-pipelined: stage rows applied sequentially in
@@ -200,9 +235,12 @@ def make_pipe_eval(cfg: GPTConfig, n_stages: int, *, interleave_v: int = 1):
     math :func:`make_sequential_loss` already proves equal). Eval is off
     the training critical path, so letting GSPMD move each P('pipe') row to
     wherever the eval computation runs is the right trade — no schedule, no
-    microbatching, just perplexity.
+    microbatching, just perplexity. ``seq_shards`` only loosens validation
+    for PP x SP configs (explicit attn_impl='ring'); the eval stages
+    themselves run mesh-less full-T attention (ring falls back to dense
+    without a mesh).
     """
-    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v)
+    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v, seq_shards)
     stage = GPTStage(cfg, per_row)
     order = pp.interleaved_stage_order(n_stages, interleave_v)
     inv = [order.index(s) for s in range(n_stages * interleave_v)]
@@ -223,10 +261,12 @@ def make_pipe_eval(cfg: GPTConfig, n_stages: int, *, interleave_v: int = 1):
 
 
 def make_sequential_loss(cfg: GPTConfig, n_stages: int, *,
-                         interleave_v: int = 1):
+                         interleave_v: int = 1, seq_shards: int = 1):
     """The unpipelined reference: identical math on the SAME stacked params
-    (stage rows applied in logical order) — the parity oracle for tests."""
-    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v)
+    (stage rows applied in logical order) — the parity oracle for tests.
+    ``seq_shards`` only loosens validation for PP x SP configs (see
+    :func:`make_pipe_eval`)."""
+    per_row = validate_pipe_cfg(cfg, n_stages, interleave_v, seq_shards)
     stage = GPTStage(cfg, per_row)
     order = pp.interleaved_stage_order(n_stages, interleave_v)
     # invert: logical stage s lives at stack row order.index(s)

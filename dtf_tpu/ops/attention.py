@@ -440,9 +440,11 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     Expects [B,H,T,D] with B on ``data``, H on ``model``, T on ``seq``;
     ``kv_mask`` [B,T] (True = valid key) sharded like the sequence. Falls
     back to dense attention when the seq axis is trivial (the shard_map
-    would just add partitioning noise).
+    would just add partitioning noise) — including ``mesh=None`` (a
+    mesh-less caller, e.g. the un-pipelined eval of a PP x SP config with
+    an explicit ``attn_impl='ring'``).
     """
-    seq_shards = mesh.shape.get("seq", 1)
+    seq_shards = mesh.shape.get("seq", 1) if mesh is not None else 1
     if seq_shards == 1:
         if k.shape[1] != q.shape[1]:          # GQA: expand for the dense
             rep = q.shape[1] // k.shape[1]    # fallback (no ring to save)

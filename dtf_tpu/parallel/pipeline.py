@@ -196,6 +196,7 @@ def pipeline_interleaved(
     *,
     axis_name: str = AXIS_PIPE,
     batch_spec: P = P("data"),
+    check_vma: bool = True,
 ):
     """Interleaved (circular) pipeline schedule — the Megatron-style
     bubble-reduction over :func:`pipeline_spmd`.
@@ -246,7 +247,8 @@ def pipeline_interleaved(
                                                     // n_stages)) + 1)
 
         def body(params, xs):
-            xs = jax.lax.pcast(xs, (axis_name,), to="varying")
+            if check_vma:
+                xs = jax.lax.pcast(xs, (axis_name,), to="varying")
             p_local = jax.tree.map(lambda t: t, params)   # [V, ...] shard
             idx = jax.lax.axis_index(axis_name)
             ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -297,6 +299,7 @@ def pipeline_interleaved(
         y = jax.shard_map(
             body, mesh=mesh,
             in_specs=(p_spec, micro_spec), out_specs=micro_spec,
+            check_vma=check_vma,
         )(params, micro)
         return y.reshape(x.shape[0:1] + y.shape[2:])
 

@@ -95,12 +95,16 @@ def main(argv):
     if pipelined:
         from dtf_tpu.models import gpt_pipe
 
-        if sp:
-            raise app.UsageError(
-                "--mesh_pipe>1 cannot combine with --mesh_seq>1: pipeline "
-                "stages run mesh-less, so seq sharding would silently "
-                "degrade to unsharded attention on permuted data")
         tp_in_pipe = mesh.shape.get("model", 1) > 1
+        if sp and tp_in_pipe:
+            raise app.UsageError(
+                "--mesh_pipe>1 with BOTH --mesh_seq>1 and --mesh_model>1 "
+                "is not supported; PP x SP runs ring/halo attention inside "
+                "the stages, PP x TP runs Megatron splits — pick one")
+        if sp and FLAGS.attn_impl == "zigzag":
+            raise app.UsageError(
+                "--attn_impl=zigzag cannot combine with --mesh_pipe>1; "
+                "PP x SP uses the plain ring (auto)")
         # microbatch rule: n_micro | batch and (batch/n_micro) % data == 0;
         # the interleaved schedule additionally needs n_micro % pipe == 0.
         # Default: the largest feasible count <= 4x stages (amortizes the
@@ -143,7 +147,8 @@ def main(argv):
                 interleave_v=FLAGS.pipe_interleave)
             param_rules = gpt_pipe.pipe_rules()
             eval_fn = gpt_pipe.make_pipe_eval(
-                cfg, n_stages, interleave_v=FLAGS.pipe_interleave)
+                cfg, n_stages, interleave_v=FLAGS.pipe_interleave,
+                seq_shards=mesh.shape.get("seq", 1))
         model = None
     else:
         # the model needs the mesh for ring attention (seq axis) AND for the

@@ -83,6 +83,18 @@ def test_gpt_pipelined_launcher_with_eval(tmp_path):
     assert "eval_ppl" in out
 
 
+def test_gpt_pp_x_sp_launcher(tmp_path):
+    """Pipeline x sequence parallelism end to end: seq-sharded microbatch
+    activations through the schedule, ring attention per shard, held-out
+    eval via the un-pipelined path."""
+    out = _run("train_gpt.py", "--size=tiny", "--mesh_pipe=2",
+               "--mesh_seq=2", "--mesh_data=2", "--eval_every=2",
+               "--train_steps=2", "--batch_size=16", "--seq_len=32",
+               f"--logdir={tmp_path}")
+    assert "done: step=2" in out
+    assert "eval_ppl" in out
+
+
 def test_gpt_train_then_generate_round_trip(tmp_path):
     """The serve path: checkpoint from train_gpt.py decoded by
     generate_gpt.py, greedy and sampled, unsharded and dp2xtp2."""
