@@ -162,6 +162,18 @@ def test_gpt_moe_learns_expert_parallel():
     assert w_in.sharding.spec == P("expert", None, None)
 
 
+def test_gpt_moe_with_sp_matches_dp():
+    """MoE x sequence parallelism: expert dispatch (GSPMD all-to-alls)
+    composed with ring attention over `seq` trains identically to the
+    same model on a pure-DP mesh."""
+    cfg = gpt.GPTConfig.tiny(moe_every=2)
+    mesh_dp = make_mesh(MeshConfig(data=8))
+    mesh_sp = make_mesh(MeshConfig(data=2, seq=2, expert=2))
+    _, l_dp = run(mesh_dp, steps=3, cfg=cfg)
+    _, l_sp = run(mesh_sp, steps=3, cfg=cfg, sp=True)
+    np.testing.assert_allclose(l_dp, l_sp, rtol=8e-4)
+
+
 def test_gpt_remat_same_loss(mesh8):
     # f32 so the only delta is remat's recompute-vs-save — which must be
     # numerically immaterial (bf16 refusion wobbles at ~1e-4 and would mask
