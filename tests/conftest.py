@@ -36,7 +36,7 @@ import pytest  # noqa: E402
 # the HLO, not the Python source.
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(_ROOT, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 
 @pytest.fixture(scope="session")
