@@ -391,13 +391,16 @@ class Block(nn.Module):
 
 
 class GPT(nn.Module):
-    """Decoder-only LM. Input ids [B,T] → logits [B,T,V]."""
+    """Decoder-only LM. Input ids [B,T] → logits [B,T,V] (or the pre-head
+    hidden states with ``return_hidden=True`` — the vocab-chunked loss
+    path applies the lm_head itself, fused chunk by chunk)."""
 
     cfg: GPTConfig
     mesh: Optional[Mesh] = None
 
     @nn.compact
-    def __call__(self, input_ids, *, deterministic: bool = True):
+    def __call__(self, input_ids, *, deterministic: bool = True,
+                 return_hidden: bool = False):
         cfg = self.cfg
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="token_embed")(input_ids)
@@ -410,6 +413,11 @@ class GPT(nn.Module):
             x = block(cfg, self.mesh, use_moe, cfg.layer_window(i),
                       name=f"layer_{i}")(x, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        if return_hidden:
+            # the chunked-loss path applies lm_head itself; the Dense
+            # below must still exist at init time, which it does — init
+            # always runs with return_hidden=False
+            return x
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="lm_head")(x)
         return logits
@@ -598,25 +606,45 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     return out
 
 
-def make_eval(model: GPT):
-    """Held-out eval: mean next-token CE and perplexity (ignore -100)."""
+def make_eval(model: GPT, *, loss_chunk: int = 0):
+    """Held-out eval: mean next-token CE and perplexity (ignore -100).
+
+    ``loss_chunk``: same vocab-chunked fused-CE option as
+    :func:`make_loss` — a training run that only fits with the chunked
+    loss would otherwise OOM at its first EVAL (full [B,T,V] logits)."""
+    from dtf_tpu.ops.losses import chunked_lm_cross_entropy
 
     def eval_fn(params, extra, batch):
         cfg = model.cfg
         out = model.apply({"params": params}, batch["input_ids"],
                           deterministic=True,
-                          mutable=["losses"] if cfg.moe_every else False)
-        logits = out[0] if cfg.moe_every else out
-        loss, _ = softmax_cross_entropy(logits, batch["labels"],
-                                        ignore_index=-100)
+                          mutable=["losses"] if cfg.moe_every else False,
+                          return_hidden=loss_chunk > 0)
+        y = out[0] if cfg.moe_every else out
+        if loss_chunk:
+            loss, _ = chunked_lm_cross_entropy(
+                y, params["lm_head"]["kernel"], batch["labels"],
+                chunk=loss_chunk, ignore_index=-100)
+        else:
+            loss, _ = softmax_cross_entropy(y, batch["labels"],
+                                            ignore_index=-100)
         return {"eval_loss": loss, "eval_ppl": jnp.exp(loss)}
 
     return eval_fn
 
 
-def make_loss(model: GPT):
+def make_loss(model: GPT, *, loss_chunk: int = 0):
     """Next-token CE: batch = {"input_ids" [B,T], "labels" [B,T]} where
-    labels are input_ids shifted left by the data layer (-100 = ignore)."""
+    labels are input_ids shifted left by the data layer (-100 = ignore).
+
+    ``loss_chunk > 0``: compute CE fused with the lm_head in vocab chunks
+    of that width (:func:`dtf_tpu.ops.losses.chunked_lm_cross_entropy`) —
+    identical numbers, O(N·chunk) instead of O(N·V) live logits memory
+    (the single-chip batch-size ceiling for a 50k vocab). Composes with
+    DP/SP; under TP (lm_head sharded over 'model') prefer the standard
+    path — the chunk slices fight the vocab sharding.
+    """
+    from dtf_tpu.ops.losses import chunked_lm_cross_entropy
 
     def loss_fn(params, extra, batch, rng):
         cfg = model.cfg
@@ -624,10 +652,16 @@ def make_loss(model: GPT):
             {"params": params}, batch["input_ids"],
             deterministic=cfg.dropout == 0.0,
             rngs={"dropout": rng} if cfg.dropout else {},
-            mutable=["losses"] if cfg.moe_every else False)
-        logits, mut = out if cfg.moe_every else (out, {})
-        loss, n = softmax_cross_entropy(logits, batch["labels"],
-                                        ignore_index=-100)
+            mutable=["losses"] if cfg.moe_every else False,
+            return_hidden=loss_chunk > 0)
+        y, mut = out if cfg.moe_every else (out, {})
+        if loss_chunk:
+            loss, n = chunked_lm_cross_entropy(
+                y, params["lm_head"]["kernel"], batch["labels"],
+                chunk=loss_chunk, ignore_index=-100)
+        else:
+            loss, n = softmax_cross_entropy(y, batch["labels"],
+                                            ignore_index=-100)
         loss = loss + moe_lib.moe_aux_loss(mut, cfg.moe)
         return loss, LossAux(extra=extra, metrics={"lm_tokens": n}, weight=n)
 

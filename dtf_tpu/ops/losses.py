@@ -30,9 +30,70 @@ def softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
     one_hot = jax.nn.one_hot(safe_labels, logits.shape[-1],
                              dtype=logits.dtype)
     picked = jnp.sum(one_hot * logits, axis=-1)
-    ce = lse - picked
-    if valid is None:
+    return _masked_mean(lse - picked, labels, ignore_index)
+
+
+def _masked_mean(ce: jax.Array, labels: jax.Array,
+                 ignore_index: int | None) -> tuple[jax.Array, jax.Array]:
+    """The shared ignore/mean tail: (mean over valid, valid_count), count
+    clamped to 1 so an all-ignored batch yields 0.0 rather than NaN. ONE
+    definition — both CE implementations promise identical semantics."""
+    if ignore_index is None:
         return ce.mean(), jnp.asarray(ce.size, jnp.float32)
+    valid = labels != ignore_index
     ce = jnp.where(valid, ce, 0.0)
     n = jnp.maximum(valid.sum().astype(jnp.float32), 1.0)
     return ce.sum() / n, n
+
+
+def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
+                             labels: jax.Array, *, chunk: int = 8192,
+                             ignore_index: int | None = None,
+                             ) -> tuple[jax.Array, jax.Array]:
+    """Next-token CE fused with the LM head, never materializing [N, V].
+
+    The full-logits path costs O(N·V) f32 twice (logits + their cotangent)
+    — 1.6 GB each for GPT-2's 50k vocab at batch 8 x seq 1024, which is
+    what caps the batch size (the single-chip MFU lever). This scans the
+    vocab in ``chunk``-column slices of the head kernel: each step is an
+    MXU-shaped [N, D] x [D, chunk] matmul feeding an online logsumexp and
+    a pick of the target logit, with the chunk rematerialized in the
+    backward (``jax.checkpoint``), so live memory is O(N·chunk).
+
+    ``x`` [..., D] (pre-head activations, post-final-LN), ``w_head``
+    [D, V] (the untied lm_head kernel), ``labels`` [...] int. Returns
+    (mean_loss, valid_count) with the same ignore/mean semantics as
+    :func:`softmax_cross_entropy` — exact same numbers, different memory.
+    """
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    v = w_head.shape[1]
+    xf = x.reshape(-1, d)
+    lab = labels.reshape(-1)
+    n = xf.shape[0]
+    n_chunks = -(-v // chunk)
+    v_pad = n_chunks * chunk
+    wp = jnp.pad(w_head, ((0, 0), (0, v_pad - v))) if v_pad != v else w_head
+
+    @jax.checkpoint
+    def body(carry, c):
+        m, s, tgt = carry                       # [N], [N], [N]
+        w_c = jax.lax.dynamic_slice_in_dim(wp, c * chunk, chunk, axis=1)
+        logits = jnp.dot(xf, w_c,
+                         preferred_element_type=jnp.float32)  # [N, chunk]
+        col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        gid = col + c * chunk                   # global vocab ids
+        logits = jnp.where(gid < v, logits, -jnp.inf)  # pad cols dead
+        m_new = jnp.maximum(m, jnp.max(logits, axis=1))
+        # m is -inf until the first live chunk; guard the rescale
+        alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_new))
+        s = s * alpha + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=1)
+        tgt = tgt + jnp.sum(
+            jnp.where(gid == lab[:, None], logits, 0.0), axis=1)
+        return (m_new, s, tgt), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32),
+            jnp.zeros((n,), jnp.float32), jnp.zeros((n,), jnp.float32))
+    (m, s, tgt), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
+    ce = (m + jnp.log(s)) - tgt                 # [N]
+    return _masked_mean(ce.reshape(lead), labels, ignore_index)

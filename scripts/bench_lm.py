@@ -91,14 +91,16 @@ def child():
         state, shardings = tr.create_train_state(
             init_fn, tx, jax.random.PRNGKey(0), mesh,
             param_rules=gpt.tp_rules, zero1=True)
-        loss_fn = gpt.make_loss(model)
+        lchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK", "0"))
+        loss_fn = gpt.make_loss(model, loss_chunk=lchunk)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   log_grad_norm=False)
         data = shard_batch(
             SyntheticData("gpt", batch, seed=0, seq_len=seq,
                           vocab_size=cfg.vocab_size).batch(0), mesh)
         row.update(batch=batch, seq=seq, attn="flash(auto)",
-                   n_params=int(_count_params(state.params)), zero1=True)
+                   n_params=int(_count_params(state.params)), zero1=True,
+                   loss_chunk=lchunk)
         unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
@@ -225,10 +227,14 @@ def main():
     artifact = ARTIFACT
     if "--sweep-gpt" in sys.argv:
         # MFU search on the flagship: batch is the main lever on a single
-        # chip (seq is fixed by the config). Results land in a separate
-        # artifact; the best batch becomes the BENCH_LM default.
-        jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b)}
-                for b in (8, 16, 32, 64)]
+        # chip (seq is fixed by the config), and the vocab-chunked loss is
+        # what makes batch >= 32 fit (full [B,T,50k] f32 logits + their
+        # cotangent would exceed HBM). Results land in a separate
+        # artifact; the best combo becomes the BENCH_LM default.
+        jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
+                 "DTF_LM_LOSS_CHUNK": c}
+                for b, c in ((8, "0"), (8, "8192"), (16, "8192"),
+                             (32, "8192"), (64, "8192"))]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
     elif "--phases-gpt" in sys.argv:
         # fwd / fwd+bwd / full-step decomposition: pins a low MFU on fwd

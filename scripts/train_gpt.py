@@ -47,6 +47,12 @@ flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
                      "default)")
 flags.DEFINE_integer("pipe_interleave", 1, "model chunks per pipe device "
                      "(Megatron interleaved schedule when >1)")
+flags.DEFINE_integer("loss_chunk_vocab", 0, "compute the LM loss fused "
+                     "with the lm_head in vocab chunks of this width "
+                     "(0 = full logits). Removes the O(batch*seq*vocab) "
+                     "logits memory — the single-chip batch ceiling. "
+                     "Not with --mesh_model (TP shards the vocab dim) or "
+                     "--mesh_pipe")
 flags.DEFINE_integer("eval_every", 0, "held-out eval (val.bin or held-out "
                      "synthetic) every N steps; 0 = final eval only. On the "
                      "pipelined path the eval step runs un-pipelined "
@@ -95,6 +101,11 @@ def main(argv):
     if pipelined:
         from dtf_tpu.models import gpt_pipe
 
+        if FLAGS.loss_chunk_vocab:
+            raise app.UsageError(
+                "--loss_chunk_vocab is not supported with --mesh_pipe "
+                "(the pipelined loss owns its head application); use it "
+                "on the non-pipelined path")
         tp_in_pipe = mesh.shape.get("model", 1) > 1
         if sp and tp_in_pipe:
             raise app.UsageError(
@@ -153,10 +164,15 @@ def main(argv):
     else:
         # the model needs the mesh for ring attention (seq axis) AND for the
         # shard_map'd flash kernel (model axis) — pass it unconditionally.
+        if FLAGS.loss_chunk_vocab and mesh.shape.get("model", 1) > 1:
+            raise app.UsageError(
+                "--loss_chunk_vocab cannot combine with --mesh_model: TP "
+                "shards the lm_head over the vocab dim, which the chunk "
+                "slices would fight (all-gathering W per chunk)")
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
-        loss_fn = gpt.make_loss(model)
+        loss_fn = gpt.make_loss(model, loss_chunk=FLAGS.loss_chunk_vocab)
         param_rules = gpt.tp_rules
-        eval_fn = gpt.make_eval(model)
+        eval_fn = gpt.make_eval(model, loss_chunk=FLAGS.loss_chunk_vocab)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=param_rules, zero1=FLAGS.zero1)

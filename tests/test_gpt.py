@@ -174,6 +174,23 @@ def test_gpt_moe_with_sp_matches_dp():
     np.testing.assert_allclose(l_dp, l_sp, rtol=8e-4)
 
 
+def test_gpt_chunked_loss_matches_full(mesh8):
+    """make_loss(loss_chunk=...) — CE fused with the lm_head in vocab
+    chunks — must train bit-comparably to the full-logits path."""
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32)
+    model, init_fn = gpt.make_init(cfg, mesh8, seq_len=SEQ)
+    tx = optax.adam(1e-3)
+    state, sh = tr.create_train_state(init_fn, tx, jax.random.PRNGKey(0),
+                                      mesh8, param_rules=gpt.tp_rules)
+    batch = shard_batch(data_batch(), mesh8)
+    rng = jax.random.PRNGKey(1)
+    full, _ = gpt.make_loss(model)(state.params, state.extra, batch, rng)
+    # chunk 48 does not divide vocab 128 — exercises the padded tail
+    chunked, _ = gpt.make_loss(model, loss_chunk=48)(
+        state.params, state.extra, batch, rng)
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
+
+
 def test_gpt_remat_same_loss(mesh8):
     # f32 so the only delta is remat's recompute-vs-save — which must be
     # numerically immaterial (bf16 refusion wobbles at ~1e-4 and would mask

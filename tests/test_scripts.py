@@ -64,9 +64,11 @@ def test_widedeep_launcher(tmp_path):
 
 
 def test_gpt_launcher_full_feature_combo(tmp_path):
-    """GQA + window + clip + eval on one run — the flag-plumbing sweep."""
+    """GQA + window + clip + eval + chunked loss on one run — the
+    flag-plumbing sweep."""
     out = _run("train_gpt.py", "--size=tiny", "--kv_heads=2",
                "--attn_window=8", "--clip_grad_norm=1.0", "--eval_every=2",
+               "--loss_chunk_vocab=48",
                "--train_steps=2", "--batch_size=16", "--seq_len=32",
                f"--logdir={tmp_path}")
     assert "done: step=2" in out
