@@ -145,7 +145,7 @@ class BertMLM(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, segment_ids, pad_mask, *,
-                 deterministic: bool = True):
+                 deterministic: bool = True, return_hidden: bool = False):
         cfg = self.cfg
         tok = nn.Embed(cfg.vocab_size, cfg.hidden, dtype=cfg.dtype,
                        param_dtype=jnp.float32, name="token_embed")
@@ -165,6 +165,11 @@ class BertMLM(nn.Module):
                      name="mlm_dense")(x)
         h = nn.gelu(h, approximate=True)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlm")(h)
+        if return_hidden:
+            # the vocab-chunked loss decodes against the tied embedding
+            # itself (init always runs return_hidden=False, so mlm_bias
+            # exists in the param tree)
+            return h
         embedding = tok.variables["params"]["embedding"]
         logits = jnp.einsum("bth,vh->btv", h.astype(jnp.float32),
                             embedding.astype(jnp.float32))
@@ -193,31 +198,52 @@ def make_init(cfg: BertConfig, mesh: Optional[Mesh] = None, seq_len: int = 128):
     return model, init_fn
 
 
-def make_eval(model: BertMLM):
-    """Held-out MLM eval: mean CE over masked positions + perplexity."""
+def _mlm_ce(model: BertMLM, params, out, labels, loss_chunk: int):
+    """CE over masked positions, full-logits or vocab-chunked against the
+    TIED embedding (transposed) + mlm_bias — one definition for loss+eval."""
+    from dtf_tpu.ops.losses import chunked_lm_cross_entropy
+
+    if loss_chunk:
+        return chunked_lm_cross_entropy(
+            out, params["token_embed"]["embedding"].T, labels,
+            chunk=loss_chunk, bias=params["mlm_bias"], ignore_index=-100)
+    return softmax_cross_entropy(out, labels, ignore_index=-100)
+
+
+def make_eval(model: BertMLM, *, loss_chunk: int = 0):
+    """Held-out MLM eval: mean CE over masked positions + perplexity.
+    ``loss_chunk``: see :func:`make_loss` — eval must fit wherever
+    training does."""
 
     def eval_fn(params, extra, batch):
-        logits = model.apply(
+        out = model.apply(
             {"params": params}, batch["input_ids"], batch["segment_ids"],
-            batch["attention_mask"].astype(bool), deterministic=True)
-        loss, _ = softmax_cross_entropy(logits, batch["mlm_labels"],
-                                        ignore_index=-100)
+            batch["attention_mask"].astype(bool), deterministic=True,
+            return_hidden=loss_chunk > 0)
+        loss, _ = _mlm_ce(model, params, out, batch["mlm_labels"],
+                          loss_chunk)
         return {"eval_mlm_loss": loss, "eval_mlm_ppl": jnp.exp(loss)}
 
     return eval_fn
 
 
-def make_loss(model: BertMLM):
-    """MLM loss: CE over masked positions (labels==-100 elsewhere)."""
+def make_loss(model: BertMLM, *, loss_chunk: int = 0):
+    """MLM loss: CE over masked positions (labels==-100 elsewhere).
+
+    ``loss_chunk > 0``: vocab-chunked fused CE against the tied embedding
+    (see :func:`dtf_tpu.ops.losses.chunked_lm_cross_entropy`) — removes
+    the O(batch·seq·vocab) logits memory. Not for TP runs (the embedding
+    is vocab-sharded P('model', None) there)."""
 
     def loss_fn(params, extra, batch, rng):
-        logits = model.apply(
+        out = model.apply(
             {"params": params}, batch["input_ids"], batch["segment_ids"],
             batch["attention_mask"].astype(bool),
             deterministic=model.cfg.dropout == 0.0,
-            rngs={"dropout": rng} if model.cfg.dropout else {})
-        loss, n = softmax_cross_entropy(logits, batch["mlm_labels"],
-                                        ignore_index=-100)
+            rngs={"dropout": rng} if model.cfg.dropout else {},
+            return_hidden=loss_chunk > 0)
+        loss, n = _mlm_ce(model, params, out, batch["mlm_labels"],
+                          loss_chunk)
         # weight=n: grad-accum combines microbatches by valid-position count,
         # matching the full-batch per-position mean exactly.
         return loss, LossAux(extra=extra, metrics={"mlm_positions": n},

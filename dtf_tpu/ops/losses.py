@@ -48,6 +48,7 @@ def _masked_mean(ce: jax.Array, labels: jax.Array,
 
 def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
                              labels: jax.Array, *, chunk: int = 8192,
+                             bias: jax.Array | None = None,
                              ignore_index: int | None = None,
                              ) -> tuple[jax.Array, jax.Array]:
     """Next-token CE fused with the LM head, never materializing [N, V].
@@ -61,9 +62,11 @@ def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
     backward (``jax.checkpoint``), so live memory is O(N·chunk).
 
     ``x`` [..., D] (pre-head activations, post-final-LN), ``w_head``
-    [D, V] (the untied lm_head kernel), ``labels`` [...] int. Returns
-    (mean_loss, valid_count) with the same ignore/mean semantics as
-    :func:`softmax_cross_entropy` — exact same numbers, different memory.
+    [D, V] (the untied lm_head kernel — or a tied embedding transposed),
+    ``bias`` optional [V] (BERT's mlm_bias), ``labels`` [...] int.
+    Returns (mean_loss, valid_count) with the same ignore/mean semantics
+    as :func:`softmax_cross_entropy` — exact same numbers, different
+    memory.
     """
     lead = x.shape[:-1]
     d = x.shape[-1]
@@ -74,6 +77,9 @@ def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
     n_chunks = -(-v // chunk)
     v_pad = n_chunks * chunk
     wp = jnp.pad(w_head, ((0, 0), (0, v_pad - v))) if v_pad != v else w_head
+    bp = None
+    if bias is not None:
+        bp = jnp.pad(bias, (0, v_pad - v)) if v_pad != v else bias
 
     @jax.checkpoint
     def body(carry, c):
@@ -81,6 +87,9 @@ def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
         w_c = jax.lax.dynamic_slice_in_dim(wp, c * chunk, chunk, axis=1)
         logits = jnp.dot(xf, w_c,
                          preferred_element_type=jnp.float32)  # [N, chunk]
+        if bp is not None:
+            logits = logits + jax.lax.dynamic_slice_in_dim(
+                bp, c * chunk, chunk)[None, :].astype(jnp.float32)
         col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         gid = col + c * chunk                   # global vocab ids
         logits = jnp.where(gid < v, logits, -jnp.inf)  # pad cols dead

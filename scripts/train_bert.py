@@ -28,6 +28,10 @@ flags.DEFINE_string("attn_impl", "auto", "auto (flash on TPU) | dense | "
                     "flash — non-seq-sharded attention backend")
 flags.DEFINE_integer("eval_every", 0, "held-out MLM eval (val.bin or "
                      "held-out synthetic) every N steps; 0 = final only")
+flags.DEFINE_integer("loss_chunk_vocab", 0, "compute the MLM loss fused "
+                     "with the tied-embedding decode in vocab chunks of "
+                     "this width (0 = full logits); not with --mesh_model "
+                     "(the embedding is vocab-sharded under TP)")
 FLAGS = flags.FLAGS
 
 
@@ -86,8 +90,14 @@ def main(argv):
         spec = P("data", "seq")
         kwargs["batch_shardings"] = batch_shardings_for(
             data.batch(0), mesh, spec)
-    step = tr.make_train_step(bert.make_loss(model), tx, mesh, shardings,
-                              grad_accum=FLAGS.grad_accum, **kwargs)
+    if FLAGS.loss_chunk_vocab and mesh.shape.get("model", 1) > 1:
+        raise app.UsageError(
+            "--loss_chunk_vocab cannot combine with --mesh_model: the "
+            "tied embedding is vocab-sharded under TP, which the chunk "
+            "slices would fight")
+    step = tr.make_train_step(
+        bert.make_loss(model, loss_chunk=FLAGS.loss_chunk_vocab), tx, mesh,
+        shardings, grad_accum=FLAGS.grad_accum, **kwargs)
 
     from dtf_tpu.core.comms import shard_batch
 
@@ -96,7 +106,8 @@ def main(argv):
                         save_interval_steps=FLAGS.checkpoint_every)
     place_batch = lambda b: shard_batch(b, mesh, spec=spec)  # noqa: E731
     eval_hook = lm_eval_hook(
-        FLAGS, info, mesh, shardings, bert.make_eval(model), writer,
+        FLAGS, info, mesh, shardings,
+        bert.make_eval(model, loss_chunk=FLAGS.loss_chunk_vocab), writer,
         place_batch, kind="bert", mode="mlm", vocab_size=cfg.vocab_size,
         batch_shardings=kwargs.get("batch_shardings"))
     trainer = Trainer(

@@ -144,3 +144,21 @@ def test_grad_accum_zero1_bert(mesh8):
     _, l_full = run(mesh8, steps=3, grad_accum=1, zero1=True)
     _, l_acc = run(mesh8, steps=3, grad_accum=2, zero1=True)
     np.testing.assert_allclose(l_full, l_acc, rtol=2e-4)
+
+
+def test_bert_chunked_loss_matches_full(mesh8):
+    """Vocab-chunked MLM loss (tied-embedding decode + bias, fused in
+    chunks) == the full-logits loss exactly."""
+    cfg = bert.BertConfig.tiny()
+    model, init_fn = bert.make_init(cfg, None, seq_len=SEQ)
+    tx = optax.adam(1e-3)
+    state, sh = tr.create_train_state(init_fn, tx, jax.random.PRNGKey(0),
+                                      mesh8, param_rules=bert.tp_rules)
+    batch = shard_batch(data_batch(), mesh8)
+    rng = jax.random.PRNGKey(1)
+    full, aux_f = bert.make_loss(model)(state.params, state.extra, batch,
+                                        rng)
+    chunked, aux_c = bert.make_loss(model, loss_chunk=48)(
+        state.params, state.extra, batch, rng)
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
+    assert float(aux_c.weight) == float(aux_f.weight)
