@@ -509,6 +509,7 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
              *, rng: Optional[jax.Array] = None,
              temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0,
+             eos_id: Optional[int] = None, pad_id: int = 0,
              mesh: Optional[Mesh] = None) -> jax.Array:
     """Autoregressive decode: one-pass prefill + a single-token ``lax.scan``.
 
@@ -520,6 +521,10 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     not T_p sequential steps); generation is then a jittable scan with the
     cache as carried state, one token per step — the standard TPU serving
     shape.
+
+    ``eos_id``: once a sequence emits it, every later token is ``pad_id``
+    (the scan stays fixed-length — static shapes — but the output is
+    properly terminated per sequence).
 
     ``mesh``: shard the decode — the KV cache lands P('data','model')
     (batch over data shards, heads over TP shards; see
@@ -589,18 +594,25 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
                               deterministic=True, mutable=["cache"])
     rng, sub = jax.random.split(rng)
     tok0 = pick(logits[:, -1], sub)
+    # EOS semantics: a sequence that has EMITTED eos_id keeps stepping (the
+    # scan is fixed-length — the standard TPU shape) but every later token
+    # is pad_id. done flips AFTER the eos token itself is kept.
+    done0 = (tok0 == eos_id) if eos_id is not None else None
 
     def body(carry, _):
-        cache, tok, rng = carry
+        cache, tok, done, rng = carry
         logits, mut = model.apply(
             {"params": params, "cache": cache}, tok[:, None],
             deterministic=True, mutable=["cache"])
         rng, sub = jax.random.split(rng)
         nxt = pick(logits[:, 0], sub)
-        return (mut["cache"], nxt, rng), nxt
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(pad_id), nxt)
+            done = done | (nxt == eos_id)
+        return (mut["cache"], nxt, done, rng), nxt
 
-    (_, _, _), toks = jax.lax.scan(
-        body, (mut["cache"], tok0, rng), None, length=n_new - 1)
+    (_, _, _, _), toks = jax.lax.scan(
+        body, (mut["cache"], tok0, done0, rng), None, length=n_new - 1)
     out = jnp.concatenate(
         [prompt, tok0[:, None], toks.T.astype(jnp.int32)], axis=1)
     return out

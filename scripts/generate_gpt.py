@@ -42,6 +42,9 @@ flags.DEFINE_float("temperature", 0.0, "0 = greedy, else sampling")
 flags.DEFINE_integer("top_k", 0, "top-k filter (0 = off)")
 flags.DEFINE_float("top_p", 1.0, "nucleus filter (1.0 = off)")
 flags.DEFINE_integer("seed", 0, "sampling PRNG seed")
+flags.DEFINE_integer("eos_id", -1, "stop token: once a sequence emits it, "
+                     "later positions are --pad_id (-1 = no stop token)")
+flags.DEFINE_integer("pad_id", 0, "pad token written after --eos_id")
 FLAGS = flags.FLAGS
 
 
@@ -109,7 +112,9 @@ def main(argv):
     out = gpt.generate(model, params, prompt, FLAGS.n_new,
                        rng=jax.random.PRNGKey(FLAGS.seed),
                        temperature=FLAGS.temperature,
-                       top_k=FLAGS.top_k, top_p=FLAGS.top_p, mesh=mesh)
+                       top_k=FLAGS.top_k, top_p=FLAGS.top_p,
+                       eos_id=FLAGS.eos_id if FLAGS.eos_id >= 0 else None,
+                       pad_id=FLAGS.pad_id, mesh=mesh)
     for row in np.asarray(out):
         print(",".join(str(int(t)) for t in row))
 

@@ -286,6 +286,34 @@ def test_filter_logits_top_k_and_top_p():
                                   np.asarray(logits))
 
 
+def test_generate_eos_pads_tail():
+    """After a sequence emits eos_id, every later position is pad_id; the
+    eos token itself is kept, and the expected output is derivable from
+    the unconstrained run (greedy is deterministic)."""
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=24)
+    model = gpt.GPT(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32))
+    prompt = jnp.asarray(data_batch(n=2)["input_ids"][:, :4])
+    free = np.asarray(gpt.generate(model, variables["params"], prompt, 12))
+    # choose row 0's THIRD generated token as the stop token
+    eos = int(free[0, 6])
+    got = np.asarray(gpt.generate(model, variables["params"], prompt, 12,
+                                  eos_id=eos, pad_id=93))
+    # expected: per row, greedy tokens until (and incl.) first eos among
+    # the generated positions, then pad — the pinned tokens never feed
+    # back differently because done rows ignore the model's pick
+    for r in range(2):
+        row, exp, done = got[r], free[r].copy(), False
+        for t in range(4, 16):
+            if done:
+                exp[t] = 93
+            elif exp[t] == eos:
+                done = True
+        np.testing.assert_array_equal(row, exp)
+    assert (got[0, 7:] == 93).all()            # row 0 padded after its eos
+
+
 def test_prefill_cache_matches_token_by_token():
     """One-pass prefill must leave the KV cache (rolling slots, per-layer
     sizes under the alternating local/global config) and the last-position
