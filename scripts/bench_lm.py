@@ -69,7 +69,9 @@ def child():
             init_fn, tx, jax.random.PRNGKey(0), mesh,
             param_rules=bert.tp_rules, zero1=True)
         lchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK", "0"))
-        loss_fn = bert.make_loss(model, loss_chunk=lchunk)
+        lgather = int(os.environ.get("DTF_LM_MLM_GATHER", "0"))
+        loss_fn = bert.make_loss(model, loss_chunk=lchunk,
+                                 mlm_gather=lgather)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   grad_accum=accum, log_grad_norm=False)
         data = shard_batch(
@@ -77,7 +79,8 @@ def child():
                           vocab_size=cfg.vocab_size).batch(0), mesh)
         n_params = _count_params(state.params)
         row.update(batch=batch, seq=seq, grad_accum=accum,
-                   n_params=int(n_params), zero1=True, loss_chunk=lchunk)
+                   n_params=int(n_params), zero1=True, loss_chunk=lchunk,
+                   mlm_gather=lgather)
         unit_scale = batch * seq  # tokens per step
     elif which == "gpt":
         from dtf_tpu.data.synthetic import SyntheticData

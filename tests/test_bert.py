@@ -162,3 +162,32 @@ def test_bert_chunked_loss_matches_full(mesh8):
         state.params, state.extra, batch, rng)
     np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
     assert float(aux_c.weight) == float(aux_f.weight)
+
+
+def test_bert_mlm_gather_matches_full_when_budget_covers(mesh8):
+    """Scoring only gathered masked positions (the max_predictions_per_seq
+    recipe) == the full path EXACTLY when the budget covers every row's
+    masked count — plain and vocab-chunked, loss AND weight."""
+    cfg = bert.BertConfig.tiny()
+    model, init_fn = bert.make_init(cfg, None, seq_len=SEQ)
+    tx = optax.adam(1e-3)
+    state, sh = tr.create_train_state(init_fn, tx, jax.random.PRNGKey(0),
+                                      mesh8, param_rules=bert.tp_rules)
+    batch = data_batch()
+    assert int((batch["mlm_labels"] != -100).sum(axis=1).max()) <= SEQ
+    budget = SEQ  # covers everything -> exact equality
+    sharded = shard_batch(batch, mesh8)
+    rng = jax.random.PRNGKey(1)
+    full, aux_f = bert.make_loss(model)(state.params, state.extra, sharded,
+                                        rng)
+    for kw in ({"mlm_gather": budget},
+               {"mlm_gather": budget, "loss_chunk": 48}):
+        got, aux_g = bert.make_loss(model, **kw)(state.params, state.extra,
+                                                 sharded, rng)
+        np.testing.assert_allclose(float(got), float(full), rtol=1e-6)
+        assert float(aux_g.weight) == float(aux_f.weight)
+    # a tiny budget drops overflow: fewer scored positions, loss finite
+    small, aux_s = bert.make_loss(model, mlm_gather=2)(
+        state.params, state.extra, sharded, rng)
+    assert np.isfinite(float(small))
+    assert float(aux_s.weight) <= 2 * batch["mlm_labels"].shape[0]

@@ -138,7 +138,8 @@ def test_bench_lm_child_tiny_mode(which, tmp_path):
         # tiny default (8) x grad_accum 2 -> microbatch 4, which the
         # 8-device sim can't shard; the TPU target is a single chip
         env["DTF_LM_BATCH"] = "32"
-        env["DTF_LM_LOSS_CHUNK"] = "48"  # CI-pin the chunked-MLM path
+        env["DTF_LM_LOSS_CHUNK"] = "48"   # CI-pin the chunked-MLM path
+        env["DTF_LM_MLM_GATHER"] = "16"   # + the masked-position gather
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "scripts", "bench_lm.py"),
          "--child"],
