@@ -240,6 +240,19 @@ def main():
                 for b, c in ((8, "0"), (8, "8192"), (16, "8192"),
                              (32, "8192"), (64, "8192"))]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
+    elif "--sweep-bert" in sys.argv:
+        # config-4 MFU levers: chunked loss, masked-position gather
+        # (~77 masked avg at 15% of seq 512; 96 covers nearly all rows),
+        # and the larger batch they unlock.
+        jobs = [
+            {"DTF_LM_WHICH": "bert"},
+            {"DTF_LM_WHICH": "bert", "DTF_LM_LOSS_CHUNK": "8192"},
+            {"DTF_LM_WHICH": "bert", "DTF_LM_LOSS_CHUNK": "8192",
+             "DTF_LM_MLM_GATHER": "96"},
+            {"DTF_LM_WHICH": "bert", "DTF_LM_BATCH": "64",
+             "DTF_LM_LOSS_CHUNK": "8192", "DTF_LM_MLM_GATHER": "96"},
+        ]
+        artifact = os.path.join(ROOT, "BENCH_LM_SWEEP_BERT.json")
     elif "--phases-gpt" in sys.argv:
         # fwd / fwd+bwd / full-step decomposition: pins a low MFU on fwd
         # math, bwd math, or the optimizer tail by subtraction.

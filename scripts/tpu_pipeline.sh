@@ -10,5 +10,6 @@ python scripts/bench_attention.py tpu --sweep-blocks
 python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --phases-gpt
+python scripts/bench_lm.py --sweep-bert
 python scripts/bench_decode.py
 python bench.py
