@@ -69,6 +69,10 @@ class GPTConfig:
     #: windowed. Each decode layer sizes its own cache (window slots for
     #: local layers, decode_len for global ones).
     attn_global_every: int = 0
+    #: flash-kernel head fold: batch this many heads per forward grid
+    #: step (must divide heads; 1 = the proven 2-D kernel). Perf knob for
+    #: the flash path only — see ops/flash_attention.py.
+    flash_block_h: int = 1
     #: every k-th block uses a Switch-MoE FFN (0 = all dense).
     moe_every: int = 0
     moe: moe_lib.MoeConfig = moe_lib.MoeConfig()
@@ -352,6 +356,7 @@ class CausalSelfAttention(nn.Module):
         elif impl == "flash":
             out = fa.flash_attention_sharded(
                 q, k, v, self.mesh, causal=True, window=self.window,
+                block_h=cfg.flash_block_h,
                 interpret=jax.default_backend() != "tpu")
         else:
             out = att.dense_attention(q, k, v, causal=True,

@@ -566,6 +566,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
                             window: int = 0,
                             kv_mask: Optional[jax.Array] = None,
+                            block_h: int = 1,
                             interpret: bool = False) -> jax.Array:
     """Per-shard flash kernel over a (data, model) mesh: batch/head dims are
     partitioned, seq stays whole per shard. Pallas calls can't be
@@ -580,7 +581,8 @@ def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
 
     if mesh is None:
         return flash_attention(q, k, v, causal=causal, window=window,
-                               kv_mask=kv_mask, interpret=interpret)
+                               kv_mask=kv_mask, block_h=block_h,
+                               interpret=interpret)
     if mesh.shape.get("seq", 1) > 1:
         # the in_specs below replicate the sequence dim, so forcing flash
         # on a seq-sharded mesh would silently all-gather T and compute the
@@ -593,13 +595,14 @@ def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
     spec = P("data", "model", None, None)
     if kv_mask is None:
         fn = functools.partial(flash_attention, causal=causal, window=window,
-                               interpret=interpret)
+                               block_h=block_h, interpret=interpret)
         return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec, check_vma=False)(q, k, v)
 
     def fn(q, k, v, m):
         return flash_attention(q, k, v, causal=causal, window=window,
-                               kv_mask=m, interpret=interpret)
+                               kv_mask=m, block_h=block_h,
+                               interpret=interpret)
 
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec, P("data", None)),

@@ -89,7 +89,12 @@ def child():
         tiny = os.environ.get("DTF_LM_TINY") == "1"  # CPU-sim logic check
         batch = int(os.environ.get("DTF_LM_BATCH", "8"))
         seq = int(os.environ.get("DTF_LM_SEQ", "64" if tiny else "1024"))
+        import dataclasses
+
         cfg = gpt.GPTConfig.tiny() if tiny else gpt.GPTConfig.gpt2_small()
+        fbh = int(os.environ.get("DTF_LM_FLASH_BH", "0"))
+        if fbh:  # flash head-fold knob (must divide heads; sweep-only)
+            cfg = dataclasses.replace(cfg, flash_block_h=fbh)
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=seq)
         tx = optax.adamw(1e-4, weight_decay=0.01)
         state, shardings = tr.create_train_state(

@@ -124,6 +124,21 @@ def test_gpt_zigzag_logits_match_dense():
                                rtol=2e-4, atol=2e-4)
 
 
+def test_gpt_flash_block_h_matches_dense():
+    """The head-folded flash grid through the MODEL path (flash_block_h
+    config knob) == dense attention."""
+    cfg_d = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="dense")
+    cfg_f = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="flash",
+                               flash_block_h=2)
+    model_d, init_fn = gpt.make_init(cfg_d, seq_len=SEQ)
+    model_f, _ = gpt.make_init(cfg_f, seq_len=SEQ)
+    variables = init_fn(jax.random.PRNGKey(0))
+    ids = jnp.asarray(data_batch(n=2)["input_ids"])
+    np.testing.assert_allclose(
+        np.asarray(model_d.apply(variables, ids)),
+        np.asarray(model_f.apply(variables, ids)), rtol=2e-4, atol=2e-4)
+
+
 def test_gpt_flash_matches_dense():
     """The Pallas kernel (interpret mode on CPU) == dense attention."""
     cfg_d = gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="dense")
