@@ -35,13 +35,13 @@ def _batches(cfg, n, batch=16, t=16):
     return out
 
 
-def _run_steps(loss_fn, init_fn, mesh, rules, batches):
+def _run_steps(loss_fn, init_fn, mesh, rules, batches, grad_accum=1):
     tx = optax.sgd(0.1)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(0), mesh, param_rules=rules,
         zero1=False)
     step = tr.make_train_step(loss_fn, tx, mesh, shardings,
-                              log_grad_norm=False)
+                              grad_accum=grad_accum, log_grad_norm=False)
     losses = []
     for b in batches:
         state, m = step(state, shard_batch(b, mesh))
@@ -172,6 +172,22 @@ def test_pipe_eval_matches_pipe_loss():
                                rtol=2e-5)
     np.testing.assert_allclose(float(m["eval_ppl"]),
                                np.exp(float(m["eval_loss"])), rtol=1e-5)
+
+
+def test_pipe_with_grad_accum_matches_plain():
+    """Gradient accumulation OUTSIDE the pipeline schedule (the launcher
+    composes both) must reproduce the unaccumulated losses exactly —
+    equal-weighted CLM microbatches make the weighted mean exact."""
+    cfg = dataclasses.replace(_tiny(), layers=4)
+    mesh = make_mesh(MeshConfig(data=4, pipe=2))
+    batches = _batches(cfg, 2)
+    init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16)
+    loss_fn = gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=2)
+    plain = _run_steps(loss_fn, init_fn, mesh, gpt_pipe.pipe_rules(),
+                       batches)
+    accum = _run_steps(loss_fn, init_fn, mesh, gpt_pipe.pipe_rules(),
+                       batches, grad_accum=2)
+    np.testing.assert_allclose(plain, accum, rtol=2e-5, atol=2e-5)
 
 
 def test_pipe_cfg_validation():
