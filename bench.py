@@ -214,6 +214,15 @@ def _attach_companion_metrics(result: dict) -> None:
     for row in rows_of("ATTN_BENCH.json", "tpu", "rows"):
         if row.get("seq") == 8192 and "fwd_speedup" in row:
             result["flash_vs_dense_fwd_8k"] = row["fwd_speedup"]
+    for row in rows_of("BENCH_LM.json", "decode", "rows"):
+        if (row.get("backend") == "tpu"
+                and row.get("decode_tokens_per_sec")):
+            tag = ("gqa" if row.get("kv_heads", 0) < row.get("heads", 0)
+                   else "mha")
+            if row.get("window"):
+                tag += "_rolling"
+            result[f"decode_{tag}_tokens_per_sec"] = \
+                row["decode_tokens_per_sec"]
 
 
 if __name__ == "__main__":
