@@ -20,7 +20,8 @@ import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
-ARTIFACT = os.path.join(ROOT, "TPU_SMOKE.json")
+ARTIFACT = (os.environ.get("DTF_SMOKE_ARTIFACT")
+            or os.path.join(ROOT, "TPU_SMOKE.json"))
 SENTINEL = "TPU_SMOKE_RESULT "
 # Probe-first budget (VERDICT r3 weak #1): fast-fail on a dead backend in
 # ~3.5 min instead of burning 3 x 600 s of child timeouts.
