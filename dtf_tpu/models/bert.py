@@ -214,11 +214,20 @@ def _gather_masked(h: jax.Array, labels: jax.Array, budget: int,
     their -100 labels (gathered from invalid positions). Exact equality
     with the full path whenever the budget covers every row's masked
     count (the CE mean is order-invariant).
+
+    Without an ``rng`` (eval), overflow drops the LAST masked positions
+    instead: keys are the position indices, so the first ``budget`` valid
+    positions are kept deterministically — a fixed random key would score
+    the same arbitrary subset every eval, which is the same bias with
+    less transparency.
     """
     valid = labels != -100
-    u = jax.random.uniform(rng if rng is not None else jax.random.PRNGKey(0),
-                           labels.shape)
-    idx = jnp.argsort(jnp.where(valid, u, 1.0 + u), axis=1)[:, :budget]
+    if rng is None:
+        key = jax.lax.broadcasted_iota(jnp.float32, labels.shape, 1)
+    else:
+        key = jax.random.uniform(rng, labels.shape)
+    span = labels.shape[1] + 1.0
+    idx = jnp.argsort(jnp.where(valid, key, span + key), axis=1)[:, :budget]
     h_g = jnp.take_along_axis(h, idx[..., None], axis=1)
     return h_g, jnp.take_along_axis(labels, idx, axis=1)
 
@@ -247,7 +256,11 @@ def _mlm_ce(model: BertMLM, params, out, labels, loss_chunk: int,
 def make_eval(model: BertMLM, *, loss_chunk: int = 0, mlm_gather: int = 0):
     """Held-out MLM eval: mean CE over masked positions + perplexity.
     ``loss_chunk``/``mlm_gather``: see :func:`make_loss` — eval must fit
-    wherever training does."""
+    wherever training does. With ``mlm_gather``, rows whose masked count
+    exceeds the budget are subsampled: eval scores the FIRST ``budget``
+    masked positions of each row (deterministic; see
+    :func:`_gather_masked`), so size the budget to
+    ``max_predictions_per_seq`` for exact full-coverage eval."""
 
     def eval_fn(params, extra, batch):
         out = model.apply(

@@ -81,6 +81,12 @@ class GPTConfig:
     #: >0 enables single-token decode mode with a KV cache of this length
     #: (the "cache" collection; see :func:`generate`).
     decode_len: int = 0
+    #: multi-token applies may CONTINUE an advanced cache: rope positions
+    #: and cache slots offset by cache_index and attention runs against the
+    #: full cache, so a long prompt can prefill in bounded-memory chunks
+    #: (``generate(..., prefill_chunk=...)``). Static flag — the default
+    #: one-shot prefill keeps its flash-kernel fast path.
+    chunked_prefill: bool = False
 
     def __post_init__(self):
         if self.kv_heads is not None and (
@@ -210,6 +216,63 @@ class CausalSelfAttention(nn.Module):
             # s's repeated kv heads).
             return jnp.repeat(a, group, axis=1) if group > 1 else a
 
+        if cfg.decode_len > 0 and t != 1 and cfg.chunked_prefill:
+            # CHUNKED PREFILL: continue a (possibly already-advanced) cache
+            # with a t-token chunk. Rope positions and cache slots offset by
+            # cache_index, and attention runs against the FULL cache — chunk
+            # i attends its own chunk's keys plus every pre-chunk position
+            # still in its window, so consecutive chunk applies reproduce
+            # the one-shot prefill exactly (parity-tested on logits). Costs
+            # [t, L+t] dense scores per layer instead of the flash kernel:
+            # the bounded-memory trade chunking exists for.
+            b = x.shape[0]
+            ck, cv, ci, cache_len, is_initialized = self._cache_vars(
+                b, kv_heads, d_head)
+            start = ci.value if is_initialized else jnp.int32(0)
+            qpos = start + jnp.arange(t)
+            q = rope(q, qpos, cfg.rope_theta)
+            k = rope(k, qpos, cfg.rope_theta)
+            # Attend against the PRE-write cache snapshot + the chunk's own
+            # K/V. Writing first and attending the cache would evict keys
+            # still inside earlier in-chunk queries' windows the moment the
+            # rolling buffer wraps (any chunk >= 2 tokens) — the snapshot
+            # keeps every key any query can legally see.
+            k_old, v_old = ck.value, cv.value
+            if is_initialized:
+                keep = min(cache_len, t)
+                wslots = jnp.remainder(qpos[t - keep:], cache_len)
+                ck.value = ck.value.at[:, :, wslots, :].set(
+                    k[:, :, t - keep:, :].astype(cfg.dtype))
+                cv.value = cv.value.at[:, :, wslots, :].set(
+                    v[:, :, t - keep:, :].astype(cfg.dtype))
+                ci.value = start + t
+            # cache slots decode at idx_old = start-1 (newest pre-chunk
+            # position congruent to s; same formula as single-token decode).
+            # All-valid < start <= qpos, so causality is automatic there.
+            slots = jnp.arange(cache_len)
+            idx_old = start - 1
+            p_s = idx_old - jnp.remainder(idx_old - slots, cache_len)
+            ok_old = jnp.broadcast_to(p_s[None, :] >= 0, (t, cache_len))
+            ok_new = qpos[None, :] <= qpos[:, None]       # intra-chunk causal
+            if self.window:
+                ok_old = ok_old & (p_s[None, :] > qpos[:, None] - self.window)
+                ok_new = ok_new & (qpos[None, :] > qpos[:, None] - self.window)
+            bias = jnp.where(jnp.concatenate([ok_old, ok_new], axis=1),
+                             0.0, -jnp.inf)               # [t, L+t]
+            keys = jnp.concatenate([k_old, k.astype(cfg.dtype)], axis=2)
+            vals = jnp.concatenate([v_old, v.astype(cfg.dtype)], axis=2)
+            qg = q.reshape(b, kv_heads, group, t, d_head)
+            s = jnp.einsum("bkgtd,bkld->bkgtl", qg, keys,
+                           preferred_element_type=jnp.float32)
+            s = s * d_head ** -0.5 + bias[None, None, None]
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bkgtl,bkld->bkgtd", p.astype(vals.dtype),
+                             vals, preferred_element_type=jnp.float32)
+            out = out.astype(cfg.dtype).transpose(0, 3, 1, 2, 4)
+            out = out.reshape(b, t, cfg.d_model)
+            return nn.Dense(cfg.d_model, dtype=cfg.dtype,
+                            param_dtype=jnp.float32, name="attn_out")(out)
+
         if cfg.decode_len > 0 and t != 1:
             # PREFILL: the whole prompt in one causal forward (parallel,
             # MXU-shaped) instead of t sequential single-token steps. The
@@ -299,8 +362,9 @@ class CausalSelfAttention(nn.Module):
                     and int(ci.value) != 0):
                 raise ValueError(
                     "multi-token decode apply needs an EMPTY cache (one-"
-                    "shot prefill); chunked prefill after decode has "
-                    "started is not supported")
+                    "shot prefill); to continue an advanced cache use "
+                    "GPTConfig(chunked_prefill=True) / "
+                    "generate(prefill_chunk=...)")
             if is_initialized:
                 keep = min(cache_len, t)
                 slots = jnp.remainder(jnp.arange(t - keep, t), cache_len)
@@ -515,6 +579,7 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
              temperature: float = 0.0,
              top_k: int = 0, top_p: float = 1.0,
              eos_id: Optional[int] = None, pad_id: int = 0,
+             prefill_chunk: int = 0,
              mesh: Optional[Mesh] = None) -> jax.Array:
     """Autoregressive decode: one-pass prefill + a single-token ``lax.scan``.
 
@@ -530,6 +595,14 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     ``eos_id``: once a sequence emits it, every later token is ``pad_id``
     (the scan stays fixed-length — static shapes — but the output is
     properly terminated per sequence).
+
+    ``prefill_chunk``: 0 = the whole prompt in one forward (fastest —
+    flash-kernel attention). >0 = prefill in chunks of that many tokens
+    via the cache-continuing path (``GPTConfig.chunked_prefill``): peak
+    prefill activation memory is O(chunk·(L+chunk)) instead of O(T_p²),
+    the knob for prompts whose one-shot score matrix doesn't fit.
+    Matches one-shot prefill logits exactly (parity-tested), including
+    rolling-window caches that wrap mid-prompt.
 
     ``mesh``: shard the decode — the KV cache lands P('data','model')
     (batch over data shards, heads over TP shards; see
@@ -594,9 +667,22 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     # PREFILL: the whole prompt in one parallel causal forward that also
     # writes the KV cache (see CausalSelfAttention's prefill branch) —
     # t_p MXU-shaped steps collapse into one, vs the old token-by-token
-    # teacher-forced loop.
-    logits, mut = model.apply({"params": params, "cache": cache0}, prompt,
-                              deterministic=True, mutable=["cache"])
+    # teacher-forced loop. With prefill_chunk, the same work runs as a
+    # static Python loop of cache-continuing applies (bounded memory).
+    if prefill_chunk > 0:
+        cmodel = GPT(dataclasses.replace(cfg, chunked_prefill=True),
+                     model.mesh)
+        cache, logits = cache0, None
+        for s0 in range(0, t_p, prefill_chunk):
+            logits, mut = cmodel.apply(
+                {"params": params, "cache": cache},
+                prompt[:, s0:s0 + prefill_chunk],
+                deterministic=True, mutable=["cache"])
+            cache = mut["cache"]
+    else:
+        logits, mut = model.apply({"params": params, "cache": cache0},
+                                  prompt, deterministic=True,
+                                  mutable=["cache"])
     rng, sub = jax.random.split(rng)
     tok0 = pick(logits[:, -1], sub)
     # EOS semantics: a sequence that has EMITTED eos_id keeps stepping (the

@@ -97,8 +97,11 @@ def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
         # m is -inf until the first live chunk; guard the rescale
         alpha = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m - m_new))
         s = s * alpha + jnp.sum(jnp.exp(logits - m_new[:, None]), axis=1)
+        # Restrict the pick to live columns: a label in [V, V_pad) would
+        # otherwise match a padded -inf column and poison tgt, where the
+        # full path's out-of-range one_hot is all-zero (picked stays 0).
         tgt = tgt + jnp.sum(
-            jnp.where(gid == lab[:, None], logits, 0.0), axis=1)
+            jnp.where((gid == lab[:, None]) & (gid < v), logits, 0.0), axis=1)
         return (m_new, s, tgt), None
 
     init = (jnp.full((n,), -jnp.inf, jnp.float32),

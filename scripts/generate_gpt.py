@@ -45,6 +45,9 @@ flags.DEFINE_integer("seed", 0, "sampling PRNG seed")
 flags.DEFINE_integer("eos_id", -1, "stop token: once a sequence emits it, "
                      "later positions are --pad_id (-1 = no stop token)")
 flags.DEFINE_integer("pad_id", 0, "pad token written after --eos_id")
+flags.DEFINE_integer("prefill_chunk", 0, "prefill the prompt in chunks of "
+                     "this many tokens (bounded-memory long prompts; "
+                     "0 = one-shot prefill)")
 FLAGS = flags.FLAGS
 
 
@@ -114,7 +117,8 @@ def main(argv):
                        temperature=FLAGS.temperature,
                        top_k=FLAGS.top_k, top_p=FLAGS.top_p,
                        eos_id=FLAGS.eos_id if FLAGS.eos_id >= 0 else None,
-                       pad_id=FLAGS.pad_id, mesh=mesh)
+                       pad_id=FLAGS.pad_id,
+                       prefill_chunk=FLAGS.prefill_chunk, mesh=mesh)
     for row in np.asarray(out):
         print(",".join(str(int(t)) for t in row))
 

@@ -350,3 +350,27 @@ def test_chunked_lm_cross_entropy_matches_full():
     for a, b in zip(gc, gf):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-5, atol=2e-6)
+
+
+def test_chunked_lm_cross_entropy_out_of_range_label_finite():
+    """A label in the pad band [V, V_pad) must not pick a padded -inf
+    column (ADVICE r4): both CE paths treat any out-of-range label as
+    picking nothing (CE = lse) — finite loss, finite grads."""
+    from dtf_tpu.ops.losses import (chunked_lm_cross_entropy,
+                                    softmax_cross_entropy)
+
+    ks = jax.random.split(jax.random.PRNGKey(3), 2)
+    x = jax.random.normal(ks[0], (2, 3, 8), jnp.float32)
+    w = jax.random.normal(ks[1], (8, 50), jnp.float32)  # chunk 32: V_pad=64
+    labels = jnp.array([[1, 55, 2], [63, 0, 70]])  # 55,63 pad band; 70 past
+
+    (lf, nf) = softmax_cross_entropy(x @ w, labels)
+    (lc, nc) = chunked_lm_cross_entropy(x, w, labels, chunk=32)
+    assert np.isfinite(float(lc))
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    assert float(nc) == float(nf)
+    grads = jax.grad(
+        lambda x, w: chunked_lm_cross_entropy(x, w, labels, chunk=32)[0],
+        (0, 1))(x, w)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()

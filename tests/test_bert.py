@@ -191,3 +191,19 @@ def test_bert_mlm_gather_matches_full_when_budget_covers(mesh8):
         state.params, state.extra, sharded, rng)
     assert np.isfinite(float(small))
     assert float(aux_s.weight) <= 2 * batch["mlm_labels"].shape[0]
+
+
+def test_gather_masked_eval_first_n_deterministic():
+    """Without an rng (eval), overflow keeps the FIRST budget masked
+    positions — deterministic and documented, instead of a fixed random
+    key's arbitrary-but-stable subset (ADVICE r4)."""
+    from dtf_tpu.models.bert import _gather_masked
+
+    labels = jnp.array([[-100, 5, -100, 7, 9, -100]])
+    h = jnp.arange(6, dtype=jnp.float32)[None, :, None] * jnp.ones((1, 6, 3))
+    h_g, l_g = _gather_masked(h, labels, 2, None)
+    np.testing.assert_array_equal(np.asarray(l_g), [[5, 7]])
+    np.testing.assert_array_equal(np.asarray(h_g[0, :, 0]), [1.0, 3.0])
+    # budget covering all masked positions keeps them all, in order
+    h_g, l_g = _gather_masked(h, labels, 3, None)
+    np.testing.assert_array_equal(np.asarray(l_g), [[5, 7, 9]])

@@ -639,3 +639,58 @@ def test_generate_sharded_validates_divisibility():
     prompt = jnp.zeros((3, 4), jnp.int32)
     with pytest.raises(ValueError, match="not divisible"):
         gpt.generate(model, variables["params"], prompt, 4, mesh=mesh)
+
+
+def _prefill_logits_parity(cfg, chunks, prompt_len=12):
+    """Chunked prefill must match one-shot prefill on LOGITS at every
+    prompt position (token-level checks can pass by argmax coincidence
+    while the cache state is wrong)."""
+    model = gpt.GPT(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32))
+    params = variables["params"]
+    prompt = jnp.asarray(data_batch(n=2)["input_ids"][:, :prompt_len])
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), jnp.zeros((2, 1),
+                                                            jnp.int32)))
+    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                          shapes["cache"])
+    want, _ = model.apply({"params": params, "cache": cache0}, prompt,
+                          mutable=["cache"])
+    cmodel = gpt.GPT(dataclasses.replace(cfg, chunked_prefill=True))
+    for chunk in chunks:
+        cache, outs = cache0, []
+        for s0 in range(0, prompt_len, chunk):
+            logits, mut = cmodel.apply(
+                {"params": params, "cache": cache},
+                prompt[:, s0:s0 + chunk], mutable=["cache"])
+            cache = mut["cache"]
+            outs.append(logits)
+        got = jnp.concatenate(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+    # decode continuation from a chunked prefill = from a one-shot one
+    want_gen = gpt.generate(model, params, prompt, 6)
+    got_gen = jax.jit(lambda p, pr: gpt.generate(
+        model, p, pr, 6, prefill_chunk=chunks[0]))(params, prompt)
+    np.testing.assert_array_equal(np.asarray(got_gen), np.asarray(want_gen))
+
+
+def test_chunked_prefill_matches_one_shot():
+    """Cache-continuing prefill (ADVICE r4 — rope positions and slots
+    offset by cache_index) on the plain cache + GQA, for ragged and
+    whole-prompt chunkings."""
+    _prefill_logits_parity(
+        gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=32, kv_heads=2),
+        chunks=(4, 5, 12))
+
+
+def test_chunked_prefill_windowed_rolling_cache():
+    """Rolling-window caches (local + global layers): the pre-write
+    snapshot keeps keys that the chunk's own writes would evict while
+    still inside earlier in-chunk queries' windows — logits parity across
+    wrap-around chunkings AND a chunk wider than the window buffer."""
+    _prefill_logits_parity(
+        gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=32, attn_window=8,
+                           attn_global_every=2),
+        chunks=(4, 5, 12))
