@@ -40,7 +40,8 @@ def define_mesh_flags():
     flags.DEFINE_integer("mesh_expert", 1, "expert-parallel (MoE) axis size")
 
 
-def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000):
+def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000,
+                       lr_schedule="constant"):
     flags.DEFINE_string("data_dir", "", "dataset directory (empty: synthetic)")
     flags.DEFINE_string("logdir", "/tmp/dtf_tpu_logs", "checkpoint/summary dir")
     flags.DEFINE_integer("batch_size", batch_size, "GLOBAL batch size (the "
@@ -52,12 +53,61 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000):
     flags.DEFINE_integer("grad_accum", 1, "gradient-accumulation microbatches")
     flags.DEFINE_float("clip_grad_norm", 0.0, "clip gradients to this global "
                        "norm before the optimizer update (0 = off)")
+    flags.DEFINE_string("lr_schedule", lr_schedule, "constant | linear | "
+                        "cosine: LR decay after warmup, over the remaining "
+                        "train_steps (see make_lr_schedule)")
+    flags.DEFINE_integer("warmup_steps", -1, "linear LR warmup 0 -> "
+                         "learning_rate over this many steps; -1 = auto "
+                         "(min(1000, train_steps/10 + 1) for decaying "
+                         "schedules, 0 for constant)")
+    flags.DEFINE_float("lr_min_ratio", 0.0, "decay floor as a fraction of "
+                       "--learning_rate (cosine alpha / linear end value)")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
     flags.DEFINE_integer("profile_steps", 0, "capture an XPlane profiler "
                          "trace spanning this many steps (0 = off); written "
                          "to <logdir>/profile")
     flags.DEFINE_integer("profile_start", 10, "step at which the profiler "
                          "trace window opens")
+
+
+def make_lr_schedule(FLAGS):
+    """--learning_rate/--lr_schedule/--warmup_steps/--lr_min_ratio -> an
+    optax schedule (or a plain float when constant with no warmup — the
+    zero-overhead path).
+
+    The schedule is what the BERT/GPT pretraining recipes assume (linear
+    warmup then decay); it composes with the rest of the optimizer story
+    because the step counter lives in the optax state: grad-accum applies
+    the update ONCE per global step (the accumulated mean gradient, so the
+    count advances per step, not per microbatch), and ZeRO-1 keeps scalar
+    state leaves replicated (core/sharding.py zero1 specs), so every shard
+    sees the same schedule position. Both are regression-tested.
+    """
+    import optax
+
+    lr = FLAGS.learning_rate
+    kind = getattr(FLAGS, "lr_schedule", "constant")
+    warmup = getattr(FLAGS, "warmup_steps", -1)
+    ratio = getattr(FLAGS, "lr_min_ratio", 0.0)
+    if warmup < 0:
+        warmup = (0 if kind == "constant"
+                  else min(1000, FLAGS.train_steps // 10 + 1))
+    if kind == "constant" and warmup == 0:
+        return lr
+    decay = max(FLAGS.train_steps - warmup, 1)
+    if kind == "constant":
+        body = optax.constant_schedule(lr)
+    elif kind == "linear":
+        body = optax.linear_schedule(lr, lr * ratio, decay)
+    elif kind == "cosine":
+        body = optax.cosine_decay_schedule(lr, decay, alpha=ratio)
+    else:
+        raise ValueError(f"unknown --lr_schedule={kind!r} "
+                         "(constant | linear | cosine)")
+    if warmup == 0:
+        return body
+    return optax.join_schedules(
+        [optax.linear_schedule(0.0, lr, warmup), body], [warmup])
 
 
 def wrap_optimizer(tx, FLAGS):

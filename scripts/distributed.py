@@ -54,7 +54,7 @@ def main(argv):
 
     model = mnist_model.make_model(FLAGS.model)
     # GradientDescentOptimizer equivalent; the reference used plain SGD.
-    tx = optax.sgd(FLAGS.learning_rate)
+    tx = optax.sgd(dflags.make_lr_schedule(FLAGS))
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         mnist_model.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed),

@@ -20,7 +20,8 @@ from dtf_tpu.cli import flags as dflags
 
 dflags.define_cluster_flags()
 dflags.define_mesh_flags()
-dflags.define_train_flags(batch_size=64, learning_rate=1e-4, train_steps=200)
+dflags.define_train_flags(batch_size=64, learning_rate=1e-4, train_steps=200,
+                          lr_schedule="cosine")
 flags.DEFINE_integer("seq_len", 128, "sequence length")
 flags.DEFINE_string("size", "base", "base | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
@@ -65,11 +66,7 @@ def main(argv):
     cfg = dataclasses.replace(cfg, attn_impl=FLAGS.attn_impl)
     model, init_fn = bert.make_init(cfg, mesh if sp else None,
                                     seq_len=FLAGS.seq_len)
-    tx = optax.adamw(
-        optax.warmup_cosine_decay_schedule(
-            0.0, FLAGS.learning_rate,
-            min(1000, FLAGS.train_steps // 10 + 1), FLAGS.train_steps),
-        weight_decay=0.01)
+    tx = optax.adamw(dflags.make_lr_schedule(FLAGS), weight_decay=0.01)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,

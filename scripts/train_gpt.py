@@ -22,7 +22,8 @@ from dtf_tpu.cli import flags as dflags
 
 dflags.define_cluster_flags()
 dflags.define_mesh_flags()
-dflags.define_train_flags(batch_size=32, learning_rate=3e-4, train_steps=200)
+dflags.define_train_flags(batch_size=32, learning_rate=3e-4, train_steps=200,
+                          lr_schedule="cosine")
 flags.DEFINE_integer("seq_len", 512, "sequence length")
 flags.DEFINE_string("size", "small", "small (gpt2-124M) | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
@@ -91,11 +92,7 @@ def main(argv):
                               attn_global_every=FLAGS.attn_global_every,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
-    tx = optax.adamw(
-        optax.warmup_cosine_decay_schedule(
-            0.0, FLAGS.learning_rate,
-            min(1000, FLAGS.train_steps // 10 + 1), FLAGS.train_steps),
-        weight_decay=0.1)
+    tx = optax.adamw(dflags.make_lr_schedule(FLAGS), weight_decay=0.1)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     pipelined = mesh.shape.get("pipe", 1) > 1
     if pipelined:

@@ -21,7 +21,8 @@ from dtf_tpu.cli import flags as dflags
 
 dflags.define_cluster_flags()
 dflags.define_mesh_flags()
-dflags.define_train_flags(batch_size=256, learning_rate=0.1, train_steps=500)
+dflags.define_train_flags(batch_size=256, learning_rate=0.1, train_steps=500,
+                          lr_schedule="cosine")
 flags.DEFINE_string("config", "cifar", "cifar (ResNet-20) | imagenet "
                     "(ResNet-50)")
 flags.DEFINE_float("weight_decay", 1e-4, "L2 on conv/dense kernels")
@@ -53,11 +54,8 @@ def main(argv):
     else:
         model, shape, kind = resnet.resnet50(), (224, 224, 3), "imagenet"
 
-    steps_total = FLAGS.train_steps
-    sched = optax.warmup_cosine_decay_schedule(
-        0.0, FLAGS.learning_rate, min(500, steps_total // 10 + 1),
-        steps_total)
-    tx = optax.sgd(sched, momentum=0.9, nesterov=True)
+    tx = optax.sgd(dflags.make_lr_schedule(FLAGS), momentum=0.9,
+                   nesterov=True)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         resnet.make_init(model, shape), tx, jax.random.PRNGKey(FLAGS.seed),

@@ -44,7 +44,7 @@ def main(argv):
 
     model = widedeep.WideDeep(hash_buckets=FLAGS.hash_buckets,
                               embed_dim=FLAGS.embed_dim)
-    tx = optax.adam(FLAGS.learning_rate)
+    tx = optax.adam(dflags.make_lr_schedule(FLAGS))
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         widedeep.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed), mesh,

@@ -127,3 +127,69 @@ def test_wrap_optimizer_clips_global_norm():
     upd0, _ = tx0.update(grads, tx0.init(params), params)
     np.testing.assert_allclose(
         float(jnp.linalg.norm(upd0["w"])), 5.0, rtol=1e-6)
+
+
+def test_make_lr_schedule_shapes():
+    """Flag -> schedule mapping: warmup ramp, decay tail, floor, the
+    constant fast path (plain float), and bad kinds rejected."""
+    from types import SimpleNamespace
+
+    from dtf_tpu.cli.flags import make_lr_schedule
+
+    def fl(**kw):
+        base = dict(learning_rate=1.0, lr_schedule="constant",
+                    warmup_steps=-1, lr_min_ratio=0.0, train_steps=100)
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    assert make_lr_schedule(fl()) == 1.0                  # plain float
+    sched = make_lr_schedule(fl(lr_schedule="linear", warmup_steps=10,
+                                lr_min_ratio=0.1))
+    np.testing.assert_allclose(float(sched(0)), 0.0)
+    np.testing.assert_allclose(float(sched(5)), 0.5)       # mid-warmup
+    np.testing.assert_allclose(float(sched(10)), 1.0)      # peak
+    np.testing.assert_allclose(float(sched(100)), 0.1)     # floor
+    cos = make_lr_schedule(fl(lr_schedule="cosine", warmup_steps=0))
+    np.testing.assert_allclose(float(cos(0)), 1.0)
+    np.testing.assert_allclose(float(cos(100)), 0.0, atol=1e-7)
+    # auto warmup: min(1000, steps//10+1) = 11 for decaying schedules
+    auto = make_lr_schedule(fl(lr_schedule="cosine"))
+    np.testing.assert_allclose(float(auto(11)), 1.0)
+    import pytest
+
+    with pytest.raises(ValueError, match="lr_schedule"):
+        make_lr_schedule(fl(lr_schedule="bogus"))
+
+
+def test_lr_schedule_composes_with_grad_accum_and_zero1(mesh8):
+    """The schedule's step counter (optax state count) advances ONCE per
+    global step under grad-accum (the update sees the accumulated mean
+    gradient) and stays consistent under ZeRO-1 sharding: accum vs
+    full-batch training stay numerically identical while the LR moves
+    through warmup+decay (VERDICT r4 #4)."""
+    from types import SimpleNamespace
+
+    from dtf_tpu.cli.flags import make_lr_schedule
+
+    sched = make_lr_schedule(SimpleNamespace(
+        learning_rate=0.1, lr_schedule="cosine", warmup_steps=3,
+        lr_min_ratio=0.0, train_steps=8))
+    results = []
+    for accum in (1, 4):
+        tx = optax.adam(sched)
+        state, shardings = tr.create_train_state(
+            linear_init, tx, jax.random.PRNGKey(0), mesh8)
+        step = tr.make_train_step(linear_loss, tx, mesh8, shardings,
+                                  grad_accum=accum)
+        batch = shard_batch(make_batch(), mesh8)
+        for _ in range(8):
+            state, _ = step(state, batch)
+        results.append(state)
+    # the schedule advanced by global steps, not microbatches: both runs
+    # end at the same schedule position with the same params
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+        results[0].params, results[1].params)
+    counts = [c for c in jax.tree.leaves(results[1].opt_state)
+              if getattr(c, "ndim", None) == 0 and c.dtype == jnp.int32]
+    assert counts and all(int(c) == 8 for c in counts)
