@@ -12,4 +12,5 @@ python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --phases-gpt
 python scripts/bench_lm.py --sweep-bert
 python scripts/bench_decode.py
+python scripts/bench_cost_table.py
 python bench.py

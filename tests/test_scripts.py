@@ -241,3 +241,31 @@ def test_generate_rejects_sampling_flags_at_greedy(tmp_path):
         env=_env(), capture_output=True, text=True, timeout=120)
     assert proc.returncode != 0
     assert "temperature" in (proc.stdout + proc.stderr)
+
+
+@pytest.mark.parametrize("which", ["gpt", "bert"])
+def test_bench_cost_table_child_tiny_mode(which):
+    """CI-pin the profiler-fallback attribution (bench_cost_table.py):
+    component rows + whole-program anchors emit, percentages computable,
+    so the on-chip run can't be the first execution of this code."""
+    env = _env()
+    env["DTF_COST_WHICH"] = which
+    env["DTF_COST_TINY"] = "1"
+    env["DTF_COST_ITERS"] = "3"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts",
+                                      "bench_cost_table.py"), "--child"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    import json
+
+    rows = [json.loads(ln[len("BENCH_COST_ROW "):])
+            for ln in proc.stdout.splitlines()
+            if ln.startswith("BENCH_COST_ROW ")]
+    assert len(rows) == 1
+    row = rows[0]
+    names = {c["component"] for c in row["components"]}
+    assert names == {"embed", "attn_layer", "ffn_layer", "head_loss"}
+    assert row["fwd_sec"] > 0 and row["fwdbwd_sec"] > row["fwd_sec"]
+    assert all(c["sec"] > 0 and c["xla_flops"] > 0
+               for c in row["components"])
