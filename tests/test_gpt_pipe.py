@@ -215,3 +215,42 @@ def test_pipe_remat_matches_plain():
             init_fn, mesh, gpt_pipe.pipe_rules(), batches)
     np.testing.assert_allclose(losses[True], losses[False],
                                rtol=1e-5, atol=1e-5)
+
+
+def test_pipe_remat_reduces_peak_temp_memory():
+    """cfg.remat must actually shrink the compiled backward's peak temp
+    allocation on the pipelined path (the GPipe-stash trade documented in
+    PERF.md 5): XLA's memory_analysis, not a proxy. Small config to keep
+    compile time down; the ratio at these shapes is ~5-9x, so 2x is a
+    safe regression floor."""
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.comms import shard_batch
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.data.synthetic import SyntheticData
+
+    mesh = make_mesh(MeshConfig(data=2, pipe=2), devices=jax.devices()[:4])
+    temps = {}
+    for remat in (False, True):
+        cfg = dataclasses.replace(_tiny(), layers=4, d_model=64, d_ff=256,
+                                  dtype=jnp.float32, remat=remat)
+        init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=128)
+        loss_fn = gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=8)
+        tx = optax.sgd(1e-3)
+        state, _ = tr.create_train_state(
+            init_fn, tx, jax.random.PRNGKey(0), mesh,
+            param_rules=gpt_pipe.pipe_rules())
+        batch = shard_batch(SyntheticData(
+            "gpt", 16, seed=0, seq_len=128,
+            vocab_size=cfg.vocab_size).batch(0), mesh)
+
+        def fwdbwd(st, bt):
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: loss_fn(p, st.extra, bt, jax.random.PRNGKey(0)),
+                has_aux=True)(st.params)
+            return loss, grads
+
+        mem = jax.jit(fwdbwd).lower(state, batch).compile().memory_analysis()
+        temps[remat] = int(mem.temp_size_in_bytes)
+    assert temps[True] * 2 < temps[False], temps
