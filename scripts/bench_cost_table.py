@@ -71,6 +71,13 @@ def child():
     which = os.environ["DTF_COST_WHICH"]
     tiny = os.environ.get("DTF_COST_TINY") == "1"
     iters = int(os.environ.get("DTF_COST_ITERS", "10"))
+    # compile-only: emit the REAL-config AOT cost tables (flops/bytes per
+    # component) with no timing loop — runs on the CPU sim any round, so
+    # the flop-share side of the attribution never waits for the tunnel.
+    compile_only = os.environ.get("DTF_COST_COMPILE_ONLY") == "1"
+
+    def timeit(fn, *args):
+        return None if compile_only else _time(fn, *args, iters=iters)
     # Single device throughout: component programs vs the full step must
     # run on the SAME resources for the subtraction to mean anything (and
     # the TPU pool is one chip; on the CPU sim this pins device 0).
@@ -100,7 +107,7 @@ def child():
         else:
             fn = jax.jit(module_or_fn)
         fl, by = _cost(fn, *args)
-        components[name] = (_time(fn, *args, iters=iters), fl, by, mult)
+        components[name] = (timeit(fn, *args), fl, by, mult)
 
     if which == "gpt":
         from dtf_tpu.data.synthetic import SyntheticData
@@ -124,8 +131,8 @@ def child():
         attn_params = attn.init(rng, x, True)
         fnattn = jax.jit(lambda p, a: attn.apply(p, a, True))
         fl, by = _cost(fnattn, attn_params, x)
-        components["attn_layer"] = (_time(fnattn, attn_params, x,
-                                          iters=iters), fl, by, layers)
+        components["attn_layer"] = (timeit(fnattn, attn_params, x),
+                                    fl, by, layers)
         add("ffn_layer", FFN(d_ff, width, cfg.dtype), layers, x)
         w_head = jax.random.normal(jax.random.PRNGKey(2), (width, vocab),
                                    jnp.float32) * 0.02
@@ -161,8 +168,8 @@ def child():
         attn_params = attn.init(rng, x, mask, True)
         fnattn = jax.jit(lambda p, a, m: attn.apply(p, a, m, True))
         fl, by = _cost(fnattn, attn_params, x, mask)
-        components["attn_layer"] = (_time(fnattn, attn_params, x, mask,
-                                          iters=iters), fl, by, layers)
+        components["attn_layer"] = (timeit(fnattn, attn_params, x, mask),
+                                    fl, by, layers)
         add("ffn_layer", FFN(d_ff, width, cfg.dtype), layers, x)
         w_head = jax.random.normal(jax.random.PRNGKey(2), (width, vocab),
                                    jnp.float32) * 0.02
@@ -196,33 +203,46 @@ def child():
     for name, fn, args in [("fwd", fwd, (state, data)),
                            ("fwdbwd", jax.jit(fwdbwd), (state, data))]:
         fl, by = _cost(fn, *args)
-        whole[name] = (_time(fn, *args, iters=iters), fl, by)
-    t0 = state
-    for _ in range(2):
-        t0, m = step(t0, data)
-    jax.block_until_ready(m["loss"])
-    t_start = time.perf_counter()
-    for _ in range(iters):
-        t0, m = step(t0, data)
-    jax.block_until_ready(m["loss"])
-    whole["step"] = ((time.perf_counter() - t_start) / iters, 0.0, 0.0)
+        whole[name] = (timeit(fn, *args), fl, by)
+    if compile_only:
+        fl, by = _cost(step, state, data)
+        whole["step"] = (None, fl, by)
+    else:
+        t0 = state
+        for _ in range(2):
+            t0, m = step(t0, data)
+        jax.block_until_ready(m["loss"])
+        t_start = time.perf_counter()
+        for _ in range(iters):
+            t0, m = step(t0, data)
+        jax.block_until_ready(m["loss"])
+        whole["step"] = ((time.perf_counter() - t_start) / iters, 0.0, 0.0)
 
-    attributed = sum(sec * mult for sec, _, _, mult in components.values())
-    rows = [{"component": n, "sec": round(sec, 6),
+    rows = [{"component": n, "sec": None if sec is None else round(sec, 6),
              "xla_flops": fl, "xla_bytes": by, "x": mult,
-             "pct_of_fwd": round(100 * sec * mult / whole["fwd"][0], 1)}
+             "pct_of_fwd_flops": round(
+                 100 * fl * mult / max(whole["fwd"][1], 1.0), 1)}
             for n, (sec, fl, by, mult) in components.items()]
     out = {"model": which, "backend": jax.default_backend(),
-           "tiny": tiny, "batch": b, "seq": s, "layers": layers,
+           "tiny": tiny, "compile_only": compile_only,
+           "batch": b, "seq": s, "layers": layers,
            "components": rows,
-           "fwd_sec": round(whole["fwd"][0], 6),
            "fwd_flops": whole["fwd"][1],
-           "fwdbwd_sec": round(whole["fwdbwd"][0], 6),
            "fwdbwd_flops": whole["fwdbwd"][1],
-           "step_sec": round(whole["step"][0], 6),
-           "unattributed_fwd_sec": round(whole["fwd"][0] - attributed, 6),
-           "mfu_fwd_xla": round(
-               whole["fwd"][1] / whole["fwd"][0] / V5E_PEAK_BF16_FLOPS, 4)}
+           "step_flops": whole["step"][1]}
+    if not compile_only:
+        attributed = sum(sec * mult
+                         for sec, _, _, mult in components.values())
+        for r in rows:
+            r["pct_of_fwd"] = round(
+                100 * r["sec"] * r["x"] / whole["fwd"][0], 1)
+        out.update(
+            fwd_sec=round(whole["fwd"][0], 6),
+            fwdbwd_sec=round(whole["fwdbwd"][0], 6),
+            step_sec=round(whole["step"][0], 6),
+            unattributed_fwd_sec=round(whole["fwd"][0] - attributed, 6),
+            mfu_fwd_xla=round(
+                whole["fwd"][1] / whole["fwd"][0] / V5E_PEAK_BF16_FLOPS, 4))
     print(SENTINEL + json.dumps(out))
 
 
@@ -232,8 +252,14 @@ def main():
 
     budget = Budget(TOTAL_BUDGET_S)
     tiny = os.environ.get("DTF_COST_TINY") == "1"
-    backend, errs = probe_backend()
-    if backend is None and not tiny:
+    compile_only = os.environ.get("DTF_COST_COMPILE_ONLY") == "1"
+    global ARTIFACT
+    if compile_only:
+        # flop-share tables need no device time: separate artifact, no
+        # probe gate (regenerable on the CPU sim any round)
+        ARTIFACT = os.path.join(ROOT, "BENCH_COST_TABLE_AOT.json")
+    backend, errs = (None, []) if compile_only else probe_backend()
+    if backend is None and not (tiny or compile_only):
         err = {"error": "backend unavailable (probe failed)",
                "attempts": errs}
         with open(ARTIFACT, "w") as f:

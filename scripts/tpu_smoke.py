@@ -169,6 +169,24 @@ def child():
     ok &= record("embed_gather_fwd", og, ot, tol=1e-6)
     ok &= record("embed_gather_bwd_scatter_add", gg, gt, tol=1e-5)
 
+    # --- chunked prefill == one-shot prefill, compiled (round 5) ---
+    # the serving memory knob (generate(prefill_chunk=...)): windowed GQA
+    # config so the rolling-cache wrap path is the thing compiled+proven
+    from dtf_tpu.models import gpt as gpt_lib
+
+    cfgp = gpt_lib.GPTConfig.tiny(dtype=jnp.float32, kv_heads=2,
+                                  decode_len=32, attn_window=8,
+                                  attn_global_every=2)
+    modelp = gpt_lib.GPT(cfgp)
+    varsp = modelp.init(jax.random.PRNGKey(3), jnp.zeros((1, 1), jnp.int32))
+    promptp = jax.random.randint(kd, (2, 12), 0, cfgp.vocab_size)
+    one = jax.jit(lambda p, pr: gpt_lib.generate(modelp, p, pr, 6))(
+        varsp["params"], promptp)
+    chk = jax.jit(lambda p, pr: gpt_lib.generate(
+        modelp, p, pr, 6, prefill_chunk=5))(varsp["params"], promptp)
+    ok &= record("chunked_prefill_decode", chk.astype(jnp.float32),
+                 one.astype(jnp.float32), tol=0.0)
+
     results["ok"] = bool(ok) and backend == "tpu"
     if backend != "tpu":
         results["note"] = (f"ran on backend={backend}; not a TPU-compiled "
