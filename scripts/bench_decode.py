@@ -46,6 +46,7 @@ def child():
     tiny = os.environ.get("DTF_DECODE_TINY") == "1"
     kv_heads = int(os.environ.get("DTF_DEC_KV", "0")) or None
     window = int(os.environ.get("DTF_DEC_WINDOW", "0"))
+    prefill_chunk = int(os.environ.get("DTF_DEC_PREFILL_CHUNK", "0"))
     if tiny:
         b, t_p, n_new = 2, 8, 8
         base = gpt.GPTConfig.tiny(dtype=jax.numpy.bfloat16)
@@ -75,8 +76,10 @@ def child():
     # prefill is ONE parallel forward (gpt.generate's prefill path); its
     # cost is measured with an n_new=1 run and subtracted so
     # decode_tokens_per_sec reflects pure single-token scan throughput.
-    run1 = jax.jit(lambda p, ids: gpt.generate(model, p, ids, 1))
-    run = jax.jit(lambda p, ids: gpt.generate(model, p, ids, n_new))
+    run1 = jax.jit(lambda p, ids: gpt.generate(
+        model, p, ids, 1, prefill_chunk=prefill_chunk))
+    run = jax.jit(lambda p, ids: gpt.generate(
+        model, p, ids, n_new, prefill_chunk=prefill_chunk))
     _, t_prefill = med_timed(lambda: run1(params, prompt))
     out, t_total = med_timed(lambda: run(params, prompt))
     assert out.shape == (b, total)
@@ -91,6 +94,7 @@ def child():
         "backend": jax.default_backend(),
         "batch": b, "prompt": t_p, "n_new": n_new,
         "kv_heads": kvh, "heads": cfg.heads, "window": window,
+        "prefill_chunk": prefill_chunk,
         "cache_mib": round(cache_bytes / 2**20, 2),
         "sec_total": round(t_total, 4),
         "prefill_s": round(t_prefill, 4),
@@ -143,6 +147,10 @@ def main():
         {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "0"},
         {"DTF_DEC_KV": "0", "DTF_DEC_WINDOW": "256"},
         {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "256"},
+        # chunked prefill over the windowed-GQA shape: the bounded-memory
+        # serving knob's cost vs its one-shot row above
+        {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "256",
+         "DTF_DEC_PREFILL_CHUNK": "64"},
     ]
 
     def on_result(row, job, rows, errors):

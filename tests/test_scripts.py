@@ -205,13 +205,16 @@ def test_bench_lm_phase_child_tiny_mode():
     assert flops["fwdbwd"] > 2.0 * flops["fwd"]
 
 
-@pytest.mark.parametrize("kv,window", [("0", "0"), ("2", "8")])
-def test_bench_decode_child_tiny_mode(kv, window):
-    """CI-pin the decode benchmark children (MHA/full and GQA/rolling
-    corners) so the serving-bench code path can't regress untested until
-    the next on-chip run."""
+@pytest.mark.parametrize("kv,window,chunk",
+                         [("0", "0", "0"), ("2", "8", "0"),
+                          ("2", "8", "4")])
+def test_bench_decode_child_tiny_mode(kv, window, chunk):
+    """CI-pin the decode benchmark children (MHA/full, GQA/rolling, and
+    chunked-prefill corners) so the serving-bench code path can't regress
+    untested until the next on-chip run."""
     env = _env()
-    env.update(DTF_DECODE_TINY="1", DTF_DEC_KV=kv, DTF_DEC_WINDOW=window)
+    env.update(DTF_DECODE_TINY="1", DTF_DEC_KV=kv, DTF_DEC_WINDOW=window,
+               DTF_DEC_PREFILL_CHUNK=chunk)
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "scripts", "bench_decode.py"),
          "--child"],
@@ -232,6 +235,7 @@ def test_bench_decode_child_tiny_mode(kv, window):
     else:
         assert row["decode_tokens_per_sec"] > 0
     assert row["kv_heads"] == (int(kv) or 4) and row["window"] == int(window)
+    assert row["prefill_chunk"] == int(chunk)
 
 
 def test_generate_rejects_sampling_flags_at_greedy(tmp_path):
