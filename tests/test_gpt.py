@@ -694,3 +694,25 @@ def test_chunked_prefill_windowed_rolling_cache():
         gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=32, attn_window=8,
                            attn_global_every=2),
         chunks=(4, 5, 12))
+
+
+def test_chunked_prefill_sharded_matches_single_device():
+    """Chunked prefill under the dp x tp serving mesh: the cache-continuing
+    branch's einsums must shard like the one-shot path (cache
+    P('data','model'), GQA head groups on the model axis) and produce the
+    exact greedy tokens of the unsharded chunked decode."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.core.sharding import shard_tree
+
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=24, kv_heads=2)
+    model = gpt.GPT(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((4, 1), jnp.int32))
+    prompt = jnp.asarray(data_batch(n=4)["input_ids"][:, :8])
+    want = gpt.generate(model, variables["params"], prompt, 8,
+                        prefill_chunk=3)
+
+    mesh = make_mesh(MeshConfig(data=4, model=2))
+    params = shard_tree(variables["params"], mesh, gpt.tp_rules)
+    got = gpt.generate(model, params, prompt, 8, prefill_chunk=3, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
