@@ -62,9 +62,15 @@ class LoggingHook(Hook):
     loop's backpressure point; every_n trades log freshness for overlap.
     """
 
-    def __init__(self, writer: MetricWriter, every_n: int = 10):
+    def __init__(self, writer: MetricWriter, every_n: int = 10,
+                 lr_schedule=None):
+        #: optional optax schedule (or plain float) to surface the current
+        #: learning rate next to the loss — the schedule position equals
+        #: the global step (one optimizer update per step; grad-accum
+        #: applies the accumulated mean gradient in that single update)
         self.writer = writer
         self.every_n = every_n
+        self.lr_schedule = lr_schedule
         self._t0 = None
         self._last_logged = None
 
@@ -81,6 +87,9 @@ class LoggingHook(Hook):
         self._t0, self._last_logged = now, step
         scalars = {k: float(v) for k, v in metrics.items()}
         scalars["steps_per_sec"] = sps
+        if self.lr_schedule is not None:
+            lr = self.lr_schedule
+            scalars["lr"] = float(lr(step) if callable(lr) else lr)
         self.writer.write_scalars(step, scalars)
 
     def end(self, state):

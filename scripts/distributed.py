@@ -54,7 +54,8 @@ def main(argv):
 
     model = mnist_model.make_model(FLAGS.model)
     # GradientDescentOptimizer equivalent; the reference used plain SGD.
-    tx = optax.sgd(dflags.make_lr_schedule(FLAGS))
+    sched = dflags.make_lr_schedule(FLAGS)
+    tx = optax.sgd(sched)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         mnist_model.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed),
@@ -90,7 +91,7 @@ def main(argv):
                         save_interval_steps=FLAGS.checkpoint_every)
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                StopAtStepHook(FLAGS.train_steps),

@@ -66,7 +66,8 @@ def main(argv):
     cfg = dataclasses.replace(cfg, attn_impl=FLAGS.attn_impl)
     model, init_fn = bert.make_init(cfg, mesh if sp else None,
                                     seq_len=FLAGS.seq_len)
-    tx = optax.adamw(dflags.make_lr_schedule(FLAGS), weight_decay=0.01)
+    sched = dflags.make_lr_schedule(FLAGS)
+    tx = optax.adamw(sched, weight_decay=0.01)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
@@ -123,7 +124,7 @@ def main(argv):
         batch_shardings=kwargs.get("batch_shardings"))
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),

@@ -92,7 +92,8 @@ def main(argv):
                               attn_global_every=FLAGS.attn_global_every,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
-    tx = optax.adamw(dflags.make_lr_schedule(FLAGS), weight_decay=0.1)
+    sched = dflags.make_lr_schedule(FLAGS)
+    tx = optax.adamw(sched, weight_decay=0.1)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     pipelined = mesh.shape.get("pipe", 1) > 1
     if pipelined:
@@ -212,7 +213,7 @@ def main(argv):
         batch_shardings=kwargs.get("batch_shardings"))
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),

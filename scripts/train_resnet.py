@@ -54,8 +54,8 @@ def main(argv):
     else:
         model, shape, kind = resnet.resnet50(), (224, 224, 3), "imagenet"
 
-    tx = optax.sgd(dflags.make_lr_schedule(FLAGS), momentum=0.9,
-                   nesterov=True)
+    sched = dflags.make_lr_schedule(FLAGS)
+    tx = optax.sgd(sched, momentum=0.9, nesterov=True)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         resnet.make_init(model, shape), tx, jax.random.PRNGKey(FLAGS.seed),
@@ -114,7 +114,7 @@ def main(argv):
             place_batch=lambda b: shard_batch(b, mesh))
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),

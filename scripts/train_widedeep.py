@@ -44,7 +44,8 @@ def main(argv):
 
     model = widedeep.WideDeep(hash_buckets=FLAGS.hash_buckets,
                               embed_dim=FLAGS.embed_dim)
-    tx = optax.adam(dflags.make_lr_schedule(FLAGS))
+    sched = dflags.make_lr_schedule(FLAGS)
+    tx = optax.adam(sched)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     state, shardings = tr.create_train_state(
         widedeep.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed), mesh,
@@ -91,7 +92,7 @@ def main(argv):
                              "split; skipping periodic eval")
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),

@@ -212,3 +212,37 @@ def test_profiler_hook_writes_xplane_trace(mesh8, tmp_path):
         state, batches(10))
     traces = list(logdir.rglob("*.xplane.pb"))
     assert traces, f"no XPlane trace written under {logdir}"
+
+
+def test_logging_hook_reports_schedule_lr(mesh8):
+    """LoggingHook(lr_schedule=...) surfaces the CURRENT schedule value
+    (and a plain float passes through) next to the step metrics."""
+    import optax
+
+    seen = {}
+
+    class CaptureWriter:
+        def write_scalars(self, step, scalars):
+            seen[step] = scalars
+
+        def flush(self):
+            pass
+
+    sched = optax.linear_schedule(1.0, 0.0, 10)
+    state, step = build(mesh8)
+    trainer = Trainer(step, mesh8,
+                      hooks=[LoggingHook(CaptureWriter(), 2,
+                                         lr_schedule=sched),
+                             StopAtStepHook(6)])
+    trainer.fit(state, batches(100))
+    assert seen, "no scalars captured"
+    for s, scalars in seen.items():
+        np.testing.assert_allclose(scalars["lr"], max(0.0, 1 - s / 10),
+                                   rtol=1e-6)
+    seen.clear()
+    trainer = Trainer(step, mesh8,
+                      hooks=[LoggingHook(CaptureWriter(), 2,
+                                         lr_schedule=0.25),
+                             StopAtStepHook(2)])
+    trainer.fit(build(mesh8)[0], batches(100))
+    assert all(v["lr"] == 0.25 for v in seen.values())
