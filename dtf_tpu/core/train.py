@@ -266,6 +266,53 @@ def make_train_step(
     )
 
 
+def make_train_step_from_grads(
+    grads_fn: Callable[..., tuple[jax.Array, "LossAux", PyTree]],
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    shardings: TrainState,
+    *,
+    log_grad_norm: bool = True,
+    donate: bool = True,
+    batch_shardings: PyTree | None = None,
+):
+    """Train step for losses that produce their own gradients.
+
+    ``grads_fn(params, extra, batch, rng) -> (loss, LossAux, grads)`` with
+    ``grads`` matching the params tree — for paths where ``jax.grad`` over
+    the loss would destroy the schedule the gradients must be computed
+    under, e.g. the fused-1F1B pipeline
+    (:func:`dtf_tpu.parallel.pipeline.pipeline_1f1b_grads`), whose O(S)
+    activation stash only exists because forward and backward interleave in
+    one scan. Microbatching lives inside such a ``grads_fn``, so there is
+    no ``grad_accum`` here; optimizer update and metrics handling are
+    identical to :func:`make_train_step`.
+    """
+
+    def step_fn(state: TrainState, batch: PyTree) -> tuple[TrainState, dict]:
+        rng = jax.random.fold_in(state.rng, state.step)
+        loss, aux, grads = grads_fn(state.params, state.extra, batch, rng)
+        metrics = dict(aux.metrics)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics["loss"] = loss
+        if log_grad_norm:
+            metrics["grad_norm"] = global_norm(grads)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt,
+            extra=aux.extra)
+        return new_state, metrics
+
+    batch_sh = (batch_shardings if batch_shardings is not None
+                else batch_sharding(mesh))
+    return jax.jit(
+        step_fn,
+        in_shardings=(shardings, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
 def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState, *,
                    batch_shardings: PyTree | None = None):
     """Compiled eval step: ``eval_fn(params, extra, batch) -> metrics dict``.

@@ -226,6 +226,72 @@ def make_pipe_loss(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
     return loss_fn
 
 
+def make_pipe_grads_1f1b(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
+                         axis_name: str = "pipe"):
+    """Grads fn (make_train_step_from_grads-compatible) running the blocks
+    through the fused-1F1B schedule
+    (:func:`dtf_tpu.parallel.pipeline.pipeline_1f1b_grads`).
+
+    Same param layout, state sharding, and numerics as
+    :func:`make_pipe_loss` + ``jax.grad`` (loss = token-mean cross-entropy,
+    gradient = d(mean)/dθ), but with an O(S) activation stash instead of
+    O(M): embedding runs inside stage 0's forward rounds, head +
+    cross-entropy (value and vjp) inside the last stage's backward rounds,
+    and each stage's backward recomputes its forward from the stashed stage
+    input. PP x SP composes exactly as in :func:`make_pipe_loss`
+    (seq-sharded microbatches, per-shard ring/halo attention); interleaved
+    chunks (``interleave_v``) are a GPipe-path-only feature.
+
+    One edge-case delta vs the un-pipelined loss: the all-ignored-tokens
+    clamp (``losses._masked_mean``) applies per micro-shard here rather
+    than once globally, so the two differ only when an entire microbatch
+    shard has zero valid label positions (it then contributes weight 1
+    with loss-sum 0 instead of nothing) — unreachable in CLM training,
+    where every position carries a label.
+    """
+    n_stages = mesh.shape.get(axis_name, 1)
+    seq_shards = mesh.shape.get("seq", 1)
+    per_row = validate_pipe_cfg(cfg, n_stages, 1, seq_shards)
+    sp = seq_shards > 1
+    stage = GPTStage(cfg, per_row, manual_seq=sp)
+    batch_spec = P("data", "seq") if sp else P("data")
+
+    def first_fn(p_embed, mb):
+        return GPTEmbed(cfg).apply({"params": p_embed}, mb["input_ids"])
+
+    def stage_fn(p, x):
+        return stage.apply({"params": p}, x)
+
+    def last_fn(p_head, y, mb):
+        logits = GPTHead(cfg).apply({"params": p_head}, y)
+        loss, n = softmax_cross_entropy(logits, mb["labels"],
+                                        ignore_index=-100)
+        n = n.astype(jnp.float32)
+        # per-(micro)shard SUM + weight; Σ over microbatches and batch
+        # shards reproduces the full-batch token mean exactly.
+        return loss * n, n
+
+    run = pp.pipeline_1f1b_grads(
+        first_fn, stage_fn, last_fn, n_microbatches, mesh,
+        axis_name=axis_name, batch_spec=batch_spec, check_vma=False)
+
+    def grads_fn(params, extra, batch, rng):
+        del rng  # blocks run deterministic inside the schedule
+        wrapped = isinstance(params, dict) and "params" in params
+        p = params["params"] if wrapped else params
+        ls, ws, (gf, gs, gl) = run(p["embed"], p["stages"], p["head"], batch)
+        scale = lambda g, ref: jax.tree.map(
+            lambda t, u: (t / ws).astype(u.dtype), g, ref)
+        g = {"embed": scale(gf, p["embed"]),
+             "stages": scale(gs, p["stages"]),
+             "head": scale(gl, p["head"])}
+        grads = {"params": g} if wrapped else g
+        return ls / ws, LossAux(extra=extra, metrics={"lm_tokens": ws},
+                                weight=ws), grads
+
+    return grads_fn
+
+
 def make_pipe_eval(cfg: GPTConfig, n_stages: int, *, interleave_v: int = 1,
                    seq_shards: int = 1):
     """Held-out eval for the pipelined param layout (VERDICT r3 #7).

@@ -166,6 +166,246 @@ def stage_param_specs(params: PyTree, axis_name: str = AXIS_PIPE) -> PyTree:
     return jax.tree.map(lambda _: P(axis_name), params)
 
 
+def _axes_of(spec: P) -> tuple[str, ...]:
+    """Flatten a PartitionSpec into the mesh axis names it mentions."""
+    axes: list[str] = []
+    for part in spec:
+        if part is None:
+            continue
+        if isinstance(part, str):
+            axes.append(part)
+        else:
+            axes.extend(part)
+    return tuple(axes)
+
+
+def pipeline_1f1b_grads(
+    first_fn: Callable[[PyTree, PyTree], jax.Array],
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    last_fn: Callable[[PyTree, jax.Array, PyTree], tuple[jax.Array, jax.Array]],
+    n_microbatches: int,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_PIPE,
+    batch_spec: P = P("data"),
+    check_vma: bool = False,
+):
+    """1F1B-style fused forward/backward pipeline — O(S) activation stash.
+
+    The GPipe/interleaved schedules above differentiate *through* the scan,
+    so autodiff stashes residuals for ALL ``M`` microbatches before the first
+    backward runs (the classic GPipe memory profile; ``jax.checkpoint`` on
+    ``stage_fn`` shrinks each stash to the stage input but not their count).
+    This schedule interleaves forwards and backwards in ONE scan so at most
+    ``2S-2`` microbatches are ever in flight per stage — the 1F1B property —
+    which means it cannot ride ``jax.grad``: it computes gradients itself
+    (per-microbatch ``jax.vjp``, backward recomputes the stage forward from
+    the stashed stage *input* — remat is built in) and returns them.
+
+    Round schedule (device ``i`` of ``S``, microbatch ``m`` of ``M``): each
+    scan round ``r`` has a forward sub-slot then a backward sub-slot, with a
+    neighbor ``ppermute`` after each:
+
+    - ``F(i, m)`` runs at round ``r = i + m`` (activations flow down one hop
+      per round, exactly like :func:`pipeline_spmd`);
+    - ``B(i, m)`` runs at round ``r = (2S-2-i) + m`` (cotangents flow back up
+      one hop per round; the last stage's ``B(S-1, m)`` shares round
+      ``S-1+m`` with its own ``F`` — loss + head run inside its backward).
+
+    Consecutive stages are one round apart in both directions, every arrival
+    is consumed the round it lands, and a stage's in-flight window
+    ``r_B - r_F = 2S-2-2i`` bounds the stash. Total rounds ``M + 2S - 2`` —
+    the same fill/drain bubble class as GPipe, at ~``S/M``-th the stash.
+
+    ``first_fn(first_params, mb) -> x`` feeds stage 0 (e.g. embedding);
+    ``last_fn(last_params, y, mb) -> (loss_sum, weight)`` consumes the final
+    stage output (e.g. LM head + cross-entropy, returning the SUM over the
+    microbatch plus its weight). The total loss is ``Σ loss_sum / Σ weight``
+    and gradients are of exactly that scalar (weights must not depend on
+    params), so results match ``jax.grad`` of the equivalent un-pipelined
+    loss. Both run under the schedule: ``first_fn`` only on stage 0's F
+    rounds, ``last_fn`` (forward + vjp) only on the last stage's B rounds.
+
+    Returns ``f(first_params, stacked_params, last_params, batch) ->
+    (loss_sum, weight, (d_first, d_stages, d_last))`` — gradient SUMS in
+    f32; divide by ``weight`` for the gradient of the mean loss.
+    ``batch`` is a pytree of ``[B, ...]`` arrays, ``B`` divisible by
+    ``n_microbatches`` x the batch shards. Per-round branch predicates
+    depend only on the pipe index, so in-branch collectives over other mesh
+    axes (e.g. ring attention over ``seq`` inside ``stage_fn``) stay
+    uniform within their groups — dp x pp x sp composes.
+    """
+    n_stages = mesh.shape.get(axis_name, 1)
+    S, M = n_stages, n_microbatches
+    reduce_axes = _axes_of(batch_spec)
+    all_axes = (axis_name,) + reduce_axes
+
+    def z32(p):
+        return jax.tree.map(lambda t: jnp.zeros(t.shape, jnp.float32), p)
+
+    def add32(a, d):
+        return jax.tree.map(lambda t, u: t + u.astype(jnp.float32), a, d)
+
+    def f(p_first, p_stack, p_last, batch):
+        b0 = jax.tree.leaves(batch)[0].shape[0]
+        if b0 % M:
+            raise ValueError(
+                f"batch {b0} not divisible by n_microbatches={M}")
+        n_stacked = jax.tree.leaves(p_stack)[0].shape[0]
+        if n_stacked != S:
+            raise ValueError(
+                f"stage stack has {n_stacked} stages but the '{axis_name}' "
+                f"mesh axis has {S} shards; they must match")
+        micro = jax.tree.map(
+            lambda x: x.reshape((M, x.shape[0] // M) + x.shape[1:]), batch)
+
+        if S == 1:
+            # degenerate pipe axis: plain per-microbatch value_and_grad,
+            # summed — identical math, no schedule.
+            def one(pf, ps, pl, mb):
+                x = first_fn(pf, mb)
+                y = stage_fn(jax.tree.map(lambda t: t[0], ps), x)
+                return last_fn(pl, y, mb)
+
+            def body(carry, mb):
+                gf, gs, gl, ls, ws = carry
+                (l, w), g = jax.value_and_grad(
+                    one, argnums=(0, 1, 2), has_aux=True)(
+                        p_first, p_stack, p_last, mb)
+                return (add32(gf, g[0]), add32(gs, g[1]), add32(gl, g[2]),
+                        ls + l, ws + w), None
+
+            (gf, gs, gl, ls, ws), _ = jax.lax.scan(
+                body, (z32(p_first), z32(p_stack), z32(p_last),
+                       jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+                micro)
+            return ls, ws, (gf, gs, gl)
+
+        C = 2 * S - 1          # stash slots; in-flight <= 2S-2 (see above)
+        R = M + 2 * S - 2      # total rounds
+
+        def body(p_first, p_stack, p_last, mb):
+            p_stage = jax.tree.map(lambda t: t[0], p_stack)
+            idx = jax.lax.axis_index(axis_name)
+            down = [(i, i + 1) for i in range(S - 1)]
+            up = [(i + 1, i) for i in range(S - 1)]
+            mb0 = jax.tree.map(lambda t: t[0], mb)
+            x_sd = jax.eval_shape(first_fn, p_first, mb0)
+            act0 = jnp.zeros(x_sd.shape, x_sd.dtype)
+            stash0 = jnp.zeros((C,) + x_sd.shape, x_sd.dtype)
+
+            def pick(m):
+                return jax.tree.map(
+                    lambda t: jax.lax.dynamic_index_in_dim(
+                        t, m, 0, keepdims=False), mb)
+
+            def round_fn(carry, r):
+                act, cot, stash, gf, gs, gl, ls, ws = carry
+                m_f = r - idx
+                f_on = (m_f >= 0) & (m_f < M)
+                m_fc = jnp.clip(m_f, 0, M - 1)
+                m_b = r - (2 * S - 2 - idx)
+                b_on = (m_b >= 0) & (m_b < M)
+                m_bc = jnp.clip(m_b, 0, M - 1)
+
+                # Control-flow invariant: ``stage_fn`` may contain
+                # collectives over OTHER mesh axes (ring/halo attention over
+                # seq, psums over data inside the stage), and collectives
+                # must never sit under pipe-varying `lax.cond` — the branch
+                # assignment then differs across pipe ranks and the lowered
+                # collective schedule corrupts values (observed on the CPU
+                # sim). So the stage forward AND its vjp run UNCONDITIONALLY
+                # every round — exactly like the GPipe schedule's bubble
+                # ticks — with `where`-selected inputs, masked writes, and a
+                # zeroed cotangent when inactive (vjp is linear in the
+                # cotangent, so inactive grad contributions are exactly 0).
+                # first_fn/last_fn stay under cond: they must be
+                # collective-free (embedding lookup / head + local loss).
+
+                # ---- forward sub-slot ----
+                mb_f = pick(m_fc)
+                x_in = jax.lax.cond(
+                    idx == 0,
+                    lambda: first_fn(p_first, mb_f).astype(act.dtype),
+                    lambda: act)
+                y = stage_fn(p_stage, x_in)
+                cur = jax.lax.dynamic_index_in_dim(stash, m_fc % C, 0,
+                                                   keepdims=False)
+                stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(f_on, x_in, cur), m_fc % C, 0)
+                act = jax.lax.ppermute(
+                    jnp.where(f_on, y, jnp.zeros_like(y)), axis_name, down)
+
+                # ---- backward sub-slot ----
+                mb_b = pick(m_bc)
+                x_b = jax.lax.dynamic_index_in_dim(stash, m_bc % C, 0,
+                                                   keepdims=False)
+                y2, svjp = jax.vjp(stage_fn, p_stage, x_b)
+
+                def last_dy(_):
+                    def lf(pl, yy):
+                        return last_fn(pl, yy, mb_b)
+                    l, lvjp, w = jax.vjp(lf, p_last, y2, has_aux=True)
+                    seed = jnp.where(b_on, jnp.ones_like(l),
+                                     jnp.zeros_like(l))
+                    dpl, dy = lvjp(seed)
+                    on = b_on.astype(jnp.float32)
+                    return (dy.astype(y2.dtype), add32(gl, dpl),
+                            ls + on * l.astype(jnp.float32),
+                            ws + on * w.astype(jnp.float32))
+
+                dy, gl, ls, ws = jax.lax.cond(
+                    idx == S - 1, last_dy,
+                    lambda _: (jnp.where(b_on, cot, jnp.zeros_like(cot)),
+                               gl, ls, ws),
+                    None)
+                dps, dx = svjp(dy)
+                gs = add32(gs, dps)
+
+                def first_g(_):
+                    _, fvjp = jax.vjp(lambda pf: first_fn(pf, mb_b),
+                                      p_first)
+                    (dpf,) = fvjp(dx.astype(x_sd.dtype))
+                    return add32(gf, dpf)
+
+                gf = jax.lax.cond(idx == 0, first_g, lambda _: gf, None)
+                cot = jax.lax.ppermute(dx.astype(act.dtype), axis_name, up)
+                return (act, cot, stash, gf, gs, gl, ls, ws), None
+
+            init = (act0, jnp.zeros_like(act0), stash0,
+                    z32(p_first), z32(p_stage), z32(p_last),
+                    jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            (_, _, _, gf, gs, gl, ls, ws), _ = jax.lax.scan(
+                round_fn, init, jnp.arange(R))
+
+            # grads/loss are partial sums: stage grads live on their own
+            # pipe rank but are partial over the batch axes; first/last
+            # grads and the loss live on one pipe rank AND are partial over
+            # the batch axes.
+            if reduce_axes:
+                gs = jax.lax.psum(gs, reduce_axes)
+            gf = jax.lax.psum(gf, all_axes)
+            gl = jax.lax.psum(gl, all_axes)
+            ls = jax.lax.psum(ls, all_axes)
+            ws = jax.lax.psum(ws, all_axes)
+            # re-stack the local stage-grad row so out_specs P(axis_name)
+            # maps rows back to the stacked layout.
+            gs = jax.tree.map(lambda t: t[None], gs)
+            return ls, ws, gf, gs, gl
+
+        micro_spec = P(None, *batch_spec)
+        ls, ws, gf, gs, gl = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(axis_name), P(),
+                      jax.tree.map(lambda _: micro_spec, batch)),
+            out_specs=(P(), P(), P(), P(axis_name), P()),
+            check_vma=check_vma,
+        )(p_first, p_stack, p_last, micro)
+        return ls, ws, (gf, gs, gl)
+
+    return f
+
+
 def interleaved_stage_order(n_devices: int, v_per_device: int) -> list[int]:
     """Stack-row order for the interleaved schedule.
 

@@ -11,7 +11,9 @@ rematerializes in the backward.
 This script puts numbers on that trade with XLA's own allocator report
 (``compiled.memory_analysis().temp_size_in_bytes`` — peak temp allocation
 of the compiled fwd+bwd program), across remat on/off and two microbatch
-counts. Pure compile-time analysis on the CPU sim: no TPU, no probe, no
+counts, plus the fused-1F1B schedule (``pipeline_1f1b_grads``: forwards
+and backwards interleaved in one scan, O(stages) stash, stage recompute
+built in) against the same model. Pure compile-time analysis on the CPU sim: no TPU, no probe, no
 timing — runnable any round regardless of the tunnel. Artifact:
 ``PIPE_MEM.json`` (+ one JSON line per row on stdout).
 """
@@ -70,17 +72,40 @@ def main():
 
             mem = (jax.jit(fwdbwd).lower(state, sharded).compile()
                    .memory_analysis())
-            row = {"remat": remat, "n_microbatches": n_micro,
+            row = {"schedule": "gpipe", "remat": remat,
+                   "n_microbatches": n_micro,
                    "temp_bytes": int(mem.temp_size_in_bytes),
                    "arg_bytes": int(mem.argument_size_in_bytes),
                    "out_bytes": int(mem.output_size_in_bytes)}
             rows.append(row)
             print(json.dumps(row), flush=True)
 
-    base_row = next(r for r in rows if not r["remat"]
-                    and r["n_microbatches"] == 8)
-    remat_row = next(r for r in rows if r["remat"]
-                     and r["n_microbatches"] == 8)
+            if remat:
+                continue   # 1f1b's remat is the schedule itself
+            grads_1f1b = gpt_pipe.make_pipe_grads_1f1b(
+                cfg, mesh, n_microbatches=n_micro)
+
+            def fwdbwd_1f1b(st, bt):
+                loss, _, grads = grads_1f1b(st.params, st.extra, bt,
+                                            jax.random.PRNGKey(0))
+                return loss, grads
+
+            mem = (jax.jit(fwdbwd_1f1b).lower(state, sharded).compile()
+                   .memory_analysis())
+            row = {"schedule": "1f1b", "remat": False,
+                   "n_microbatches": n_micro,
+                   "temp_bytes": int(mem.temp_size_in_bytes),
+                   "arg_bytes": int(mem.argument_size_in_bytes),
+                   "out_bytes": int(mem.output_size_in_bytes)}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+
+    base_row = next(r for r in rows if r["schedule"] == "gpipe"
+                    and not r["remat"] and r["n_microbatches"] == 8)
+    remat_row = next(r for r in rows if r["schedule"] == "gpipe"
+                     and r["remat"] and r["n_microbatches"] == 8)
+    f1b_row = next(r for r in rows if r["schedule"] == "1f1b"
+                   and r["n_microbatches"] == 8)
     summary = {
         "config": {"d_model": base.d_model, "layers": base.layers,
                    "d_ff": base.d_ff, "seq": seq, "batch": batch,
@@ -89,11 +114,17 @@ def main():
         "rows": rows,
         "remat_temp_reduction_at_m8": round(
             base_row["temp_bytes"] / max(remat_row["temp_bytes"], 1), 2),
+        "1f1b_temp_reduction_at_m8": round(
+            base_row["temp_bytes"] / max(f1b_row["temp_bytes"], 1), 2),
+        "1f1b_vs_gpipe_remat_at_m8": round(
+            remat_row["temp_bytes"] / max(f1b_row["temp_bytes"], 1), 2),
     }
     with open(ARTIFACT, "w") as f:
         json.dump(summary, f, indent=1)
     print(json.dumps({"remat_temp_reduction_at_m8":
-                      summary["remat_temp_reduction_at_m8"]}))
+                      summary["remat_temp_reduction_at_m8"],
+                      "1f1b_temp_reduction_at_m8":
+                      summary["1f1b_temp_reduction_at_m8"]}))
 
 
 if __name__ == "__main__":

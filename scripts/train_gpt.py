@@ -48,6 +48,12 @@ flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
                      "default)")
 flags.DEFINE_integer("pipe_interleave", 1, "model chunks per pipe device "
                      "(Megatron interleaved schedule when >1)")
+flags.DEFINE_enum("pipe_schedule", "gpipe", ["gpipe", "1f1b"],
+                  "pipeline schedule: gpipe (autodiff through the scan; "
+                  "O(M) activation stash, shrink it with --remat) or 1f1b "
+                  "(fused forward/backward rounds; O(stages) stash, remat "
+                  "built in — for depth-sharded models that exceed HBM "
+                  "under gpipe)")
 flags.DEFINE_integer("loss_chunk_vocab", 0, "compute the LM loss fused "
                      "with the lm_head in vocab chunks of this width "
                      "(0 = full logits). Removes the O(batch*seq*vocab) "
@@ -96,6 +102,7 @@ def main(argv):
     tx = optax.adamw(sched, weight_decay=0.1)
     tx = dflags.wrap_optimizer(tx, FLAGS)
     pipelined = mesh.shape.get("pipe", 1) > 1
+    grads_fn = None   # set by --pipe_schedule=1f1b (fused fwd/bwd path)
     if pipelined:
         from dtf_tpu.models import gpt_pipe
 
@@ -134,6 +141,17 @@ def main(argv):
             n_micro = max(cands)
             absl_logging.info("pipeline: using %d microbatches", n_micro)
         n_stages = mesh.shape["pipe"]
+        if FLAGS.pipe_schedule == "1f1b":
+            if FLAGS.pipe_interleave != 1 or tp_in_pipe:
+                raise app.UsageError(
+                    "--pipe_schedule=1f1b supports neither "
+                    "--pipe_interleave>1 nor --mesh_model>1; it composes "
+                    "with data and seq sharding")
+            if FLAGS.grad_accum != 1:
+                raise app.UsageError(
+                    "--grad_accum>1 is redundant with --pipe_schedule=1f1b "
+                    "(microbatch accumulation is the schedule); raise "
+                    "--pipe_microbatches instead")
         if tp_in_pipe:
             from dtf_tpu.models import gpt_pipe_tp
 
@@ -151,9 +169,14 @@ def main(argv):
             init_fn = gpt_pipe.make_pipe_init(
                 cfg, mesh, seq_len=FLAGS.seq_len,
                 interleave_v=FLAGS.pipe_interleave)
-            loss_fn = gpt_pipe.make_pipe_loss(
-                cfg, mesh, n_microbatches=n_micro,
-                interleave_v=FLAGS.pipe_interleave)
+            if FLAGS.pipe_schedule == "1f1b":
+                grads_fn = gpt_pipe.make_pipe_grads_1f1b(
+                    cfg, mesh, n_microbatches=n_micro)
+                loss_fn = None
+            else:
+                loss_fn = gpt_pipe.make_pipe_loss(
+                    cfg, mesh, n_microbatches=n_micro,
+                    interleave_v=FLAGS.pipe_interleave)
             param_rules = gpt_pipe.pipe_rules()
             eval_fn = gpt_pipe.make_pipe_eval(
                 cfg, n_stages, interleave_v=FLAGS.pipe_interleave,
@@ -195,8 +218,12 @@ def main(argv):
         spec = P("data", "seq")
         kwargs["batch_shardings"] = batch_shardings_for(
             data.batch(0), mesh, spec)
-    step = tr.make_train_step(loss_fn, tx, mesh, shardings,
-                              grad_accum=FLAGS.grad_accum, **kwargs)
+    if grads_fn is not None:
+        step = tr.make_train_step_from_grads(grads_fn, tx, mesh, shardings,
+                                             **kwargs)
+    else:
+        step = tr.make_train_step(loss_fn, tx, mesh, shardings,
+                                  grad_accum=FLAGS.grad_accum, **kwargs)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),

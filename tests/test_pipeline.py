@@ -166,3 +166,88 @@ def test_stack_stage_params_roundtrip():
     assert jax.tree.leaves(stacked)[0].shape[0] == 3
     np.testing.assert_array_equal(
         np.asarray(stacked["w"][1]), np.asarray(per_stage[1]["w"]))
+
+
+# ---------------------------------------------------------------------------
+# fused-1F1B schedule: grads computed inside the schedule (no jax.grad)
+# ---------------------------------------------------------------------------
+
+def _1f1b_parts(d):
+    def first_fn(pf, mb):
+        return jnp.tanh(mb["x"] @ pf["e"])
+
+    def last_fn(pl, y, mb):
+        pred = y @ pl["h"]
+        return jnp.sum((pred - mb["t"]) ** 2), jnp.float32(mb["t"].shape[0])
+
+    k = jax.random.split(jax.random.PRNGKey(7), 3)
+    p_first = {"e": jax.random.normal(k[0], (d, d)) * 0.3}
+    p_last = {"h": jax.random.normal(k[1], (d, d)) * 0.3}
+    return first_fn, last_fn, p_first, p_last
+
+
+def _1f1b_ref(first_fn, last_fn, p_first, p_stack, p_last, batch):
+    """Oracle: sequential stages, jax.grad of (Σ loss_sum / Σ weight)."""
+    def loss(pf, ps, pl):
+        x = first_fn(pf, batch)
+        x = sequential(ps, x)
+        ls, w = last_fn(pl, x, batch)
+        return ls / w
+    return jax.value_and_grad(loss, argnums=(0, 1, 2))(
+        p_first, p_stack, p_last)
+
+
+@pytest.mark.parametrize("micro", [4, 8, 2])   # M > S, M = 2S, M < S
+def test_1f1b_matches_sequential_grad(mesh_dp2_pp4, micro):
+    d, batch = 8, 16
+    first_fn, last_fn, p_first, p_last = _1f1b_parts(d)
+    p_stack = pp.init_stacked(make_stage_init(d), 4, jax.random.PRNGKey(1))
+    b = {"x": jax.random.normal(jax.random.PRNGKey(2), (batch, d)),
+         "t": jax.random.normal(jax.random.PRNGKey(3), (batch, d))}
+
+    run = pp.pipeline_1f1b_grads(first_fn, stage_fn, last_fn, micro,
+                                 mesh_dp2_pp4)
+    ls, ws, (gf, gs, gl) = jax.jit(run)(p_first, p_stack, p_last, b)
+    want_l, want_g = _1f1b_ref(first_fn, last_fn, p_first, p_stack, p_last, b)
+
+    np.testing.assert_allclose(float(ls / ws), float(want_l), rtol=1e-5)
+    for got, want in zip((gf, gs, gl), want_g):
+        jax.tree.map(
+            lambda a, b_: np.testing.assert_allclose(
+                np.asarray(a) / float(ws), np.asarray(b_),
+                rtol=1e-4, atol=1e-5),
+            got, want)
+
+
+def test_1f1b_degenerate_single_stage():
+    mesh = make_mesh(MeshConfig(data=8))
+    d, batch, micro = 8, 16, 4
+    first_fn, last_fn, p_first, p_last = _1f1b_parts(d)
+    p_stack = pp.init_stacked(make_stage_init(d), 1, jax.random.PRNGKey(1))
+    b = {"x": jax.random.normal(jax.random.PRNGKey(2), (batch, d)),
+         "t": jax.random.normal(jax.random.PRNGKey(3), (batch, d))}
+    run = pp.pipeline_1f1b_grads(first_fn, stage_fn, last_fn, micro, mesh)
+    ls, ws, grads = jax.jit(run)(p_first, p_stack, p_last, b)
+    want_l, want_g = _1f1b_ref(first_fn, last_fn, p_first, p_stack, p_last, b)
+    np.testing.assert_allclose(float(ls / ws), float(want_l), rtol=1e-5)
+    for got, want in zip(grads, want_g):
+        jax.tree.map(
+            lambda a, b_: np.testing.assert_allclose(
+                np.asarray(a) / float(ws), np.asarray(b_),
+                rtol=1e-4, atol=1e-5),
+            got, want)
+
+
+def test_1f1b_rejects_bad_shapes(mesh_dp2_pp4):
+    d = 4
+    first_fn, last_fn, p_first, p_last = _1f1b_parts(d)
+    run = pp.pipeline_1f1b_grads(first_fn, stage_fn, last_fn, 3, mesh_dp2_pp4)
+    b = {"x": jnp.zeros((16, d)), "t": jnp.zeros((16, d))}
+    with pytest.raises(ValueError, match="not divisible"):
+        run(p_first, pp.init_stacked(make_stage_init(d), 4,
+                                     jax.random.PRNGKey(0)), p_last, b)
+    run4 = pp.pipeline_1f1b_grads(first_fn, stage_fn, last_fn, 4,
+                                  mesh_dp2_pp4)
+    with pytest.raises(ValueError, match="must match"):
+        run4(p_first, pp.init_stacked(make_stage_init(d), 6,
+                                      jax.random.PRNGKey(0)), p_last, b)
