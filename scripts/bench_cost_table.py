@@ -260,10 +260,18 @@ def main():
         ARTIFACT = os.path.join(ROOT, "BENCH_COST_TABLE_AOT.json")
     backend, errs = (None, []) if compile_only else probe_backend()
     if backend is None and not (tiny or compile_only):
+        # preserve any previously-banked rows (the tpu_smoke.py stale-but-
+        # honest pattern): a failed attempt must not clobber good data
         err = {"error": "backend unavailable (probe failed)",
                "attempts": errs}
+        try:
+            with open(ARTIFACT) as f:
+                prev = json.load(f)
+        except (OSError, ValueError):
+            prev = {"rows": [], "errors": []}
+        prev["last_attempt_error"] = err
         with open(ARTIFACT, "w") as f:
-            json.dump({"rows": [], "errors": [err]}, f, indent=1)
+            json.dump(prev, f, indent=1)
         print(json.dumps(err))
         return
     jobs = [{"DTF_COST_WHICH": "bert"}, {"DTF_COST_WHICH": "gpt"}]
