@@ -120,6 +120,21 @@ def run_budgeted_jobs(jobs: list, argv: list[str], parse_line, *,
     return rows, errors
 
 
+def fence(out):
+    """Block until a device computation has ACTUALLY finished, by host
+    readback. The canonical timing fence for every bench child in this
+    repo: ``jax.block_until_ready`` returns early on the axon PJRT plugin
+    (PERF.md §4; rediscovered the hard way by the first decode-bench rows,
+    which timed pure dispatch latency), so correct fencing must pull bytes
+    to the host — a transfer cannot complete before the program has.
+    Accepts any array / pytree; returns the first leaf as a numpy array.
+    """
+    import jax
+    import numpy as np
+
+    return np.asarray(jax.tree.leaves(out)[0])
+
+
 def probe_backend(*, timeout_s: float = 90, retries: int = 2,
                   backoff_s: float = 10, env: Optional[dict] = None):
     """Cheap availability check run BEFORE any expensive measurement child.

@@ -62,6 +62,13 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000,
                          "schedules, 0 for constant)")
     flags.DEFINE_float("lr_min_ratio", 0.0, "decay floor as a fraction of "
                        "--learning_rate (cosine alpha / linear end value)")
+    flags.DEFINE_string("optimizer", "", "override the script's recipe "
+                        "optimizer: sgd | momentum | adam | adamw | lamb | "
+                        "adafactor (empty = keep the recipe default). lamb "
+                        "is the BERT-at-scale recipe; adafactor is the "
+                        "memory-lean TPU option (factored second moments)")
+    flags.DEFINE_float("weight_decay", -1.0, "weight decay for "
+                       "adamw/lamb overrides (-1 = optimizer default)")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
     flags.DEFINE_integer("profile_steps", 0, "capture an XPlane profiler "
                          "trace spanning this many steps (0 = off); written "
@@ -108,6 +115,76 @@ def make_lr_schedule(FLAGS):
         return body
     return optax.join_schedules(
         [optax.linear_schedule(0.0, lr, warmup), body], [warmup])
+
+
+def make_optimizer(FLAGS, recipe, recipe_uses_wd=False):
+    """The script's full optimizer story in one call: LR schedule →
+    ``--optimizer`` override (or the script's recipe default) →
+    :func:`wrap_optimizer` shaping.
+
+    ``recipe``: ``callable(schedule) -> optax.GradientTransformation`` —
+    the launcher's era-faithful default (e.g. adamw(wd=0.01) for BERT,
+    nesterov SGD for ResNet), used when ``--optimizer`` is empty so
+    existing launch commands keep their exact numerics.
+    ``recipe_uses_wd=True`` declares that the recipe itself consumes
+    ``--weight_decay`` (BERT/GPT pass it into their adamw; ResNet maps
+    it to loss-side L2); otherwise an explicitly-set ``--weight_decay``
+    that nothing would consume raises instead of silently training
+    without it. Every named override composes with ZeRO-1 (param-shaped state shards via
+    ``zero1_opt_specs``; adafactor's rank-reduced factored moments fall
+    back to a fresh data-axis spec — see ``_zero1_leaf_spec``),
+    grad-accum (one update per global step) and the LR schedule (step
+    count lives in optax state); regression-tested in
+    tests/test_optimizers.py.
+    """
+    import optax
+
+    sched = make_lr_schedule(FLAGS)
+    name = (getattr(FLAGS, "optimizer", "") or "").lower()
+    wd = getattr(FLAGS, "weight_decay", -1.0)
+
+    def decay(default):
+        return wd if wd >= 0.0 else default
+
+    def reject_wd():
+        # A silently-dropped hyperparameter is worse than an error: a
+        # --weight_decay sweep over an optimizer that ignores it would
+        # train N identical runs.
+        if wd >= 0.0:
+            raise ValueError(
+                f"--weight_decay has no effect with "
+                f"--optimizer={name or '<recipe default>'}; use "
+                "adamw | lamb | adafactor (or a launcher whose recipe "
+                "consumes it)")
+
+    if not name:
+        if not recipe_uses_wd:
+            reject_wd()
+        tx = recipe(sched)
+    elif name == "sgd":
+        reject_wd()
+        tx = optax.sgd(sched)
+    elif name == "momentum":
+        reject_wd()
+        tx = optax.sgd(sched, momentum=0.9, nesterov=True)
+    elif name == "adam":
+        reject_wd()
+        tx = optax.adam(sched)
+    elif name == "adamw":
+        tx = optax.adamw(sched, weight_decay=decay(1e-4))   # optax default
+    elif name == "lamb":
+        tx = optax.lamb(sched, weight_decay=decay(0.0))     # optax default
+    elif name == "adafactor":
+        # adafactor consumes the schedule directly (it scales updates by
+        # its own RMS rule); decay rides optax's weight_decay_rate arg
+        tx = optax.adafactor(
+            learning_rate=sched,
+            weight_decay_rate=(wd if wd >= 0.0 else None))
+    else:
+        raise ValueError(
+            f"unknown --optimizer={name!r} "
+            "(sgd | momentum | adam | adamw | lamb | adafactor)")
+    return wrap_optimizer(tx, FLAGS)
 
 
 def wrap_optimizer(tx, FLAGS):

@@ -80,11 +80,27 @@ def shard_tree(tree: PyTree, mesh: Mesh, rules: Sequence[Rule] = (),
 # ZeRO-1: optimizer-state sharding over the data axis.
 # ---------------------------------------------------------------------------
 
+def _mirrors_param(shape: tuple[int, ...],
+                   param_shape: tuple[int, ...] | None) -> bool:
+    """Does a state leaf have the param's own layout (adam mu/nu, momentum)?
+    adafactor's factored second moments are rank-reduced or placeholder-
+    shaped ((d0,) / (1,) for a 2-D param; (1,) for a 1-D one), and the
+    param's PartitionSpec must NOT apply to those — a P("model") bias spec
+    on a (1,) placeholder is an invalid sharding."""
+    return param_shape is None or tuple(shape) == tuple(param_shape)
+
+
 def _zero1_leaf_spec(param_spec: P, shape: tuple[int, ...], data_size: int,
-                     axis: str) -> P:
+                     axis: str, param_shape: tuple[int, ...] | None = None
+                     ) -> P:
     """Extend a param's spec by sharding its first free divisible dim over
     ``axis``. Scalars / indivisible leaves stay at the param's own spec."""
-    spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
+    if not _mirrors_param(shape, param_shape) or len(param_spec) > len(shape):
+        # State leaf does not mirror the param's layout — start fresh and
+        # let the data-axis pass below shard the leaf if a dim divides.
+        spec = [None] * len(shape)
+    else:
+        spec = list(param_spec) + [None] * (len(shape) - len(param_spec))
     used = {a for s in spec for a in ((s,) if isinstance(s, str) else (s or ()))}
     if data_size > 1 and axis not in used:
         for i, (s, dim) in enumerate(zip(spec, shape)):
@@ -107,18 +123,28 @@ def zero1_opt_specs(tx: optax.GradientTransformation, params: PyTree,
     data_size = mesh.shape.get(axis, 1)
     abstract_state = jax.eval_shape(tx.init, params)
 
-    def leaf_spec(state_leaf, spec):
-        return _zero1_leaf_spec(spec, state_leaf.shape, data_size, axis)
+    def leaf_spec(state_leaf, spec, param):
+        return _zero1_leaf_spec(spec, state_leaf.shape, data_size, axis,
+                                param_shape=param.shape)
 
     return optax.tree_map_params(
         tx, leaf_spec, abstract_state, param_specs,
+        jax.eval_shape(lambda p: p, params),
         transform_non_params=lambda _: REPLICATED)
 
 
 def opt_specs_like_params(tx: optax.GradientTransformation, params: PyTree,
                           param_specs: PyTree) -> PyTree:
-    """Optimizer-state specs mirroring the params' specs (no ZeRO)."""
+    """Optimizer-state specs mirroring the params' specs (no ZeRO).
+
+    Only leaves that actually have the param's shape take its spec;
+    rank-reduced / placeholder leaves (adafactor's factored moments) are
+    replicated — the param's spec would be an invalid sharding for them.
+    """
     abstract_state = jax.eval_shape(tx.init, params)
     return optax.tree_map_params(
-        tx, lambda _leaf, spec: spec, abstract_state, param_specs,
+        tx,
+        lambda leaf, spec, param: (
+            spec if _mirrors_param(leaf.shape, param.shape) else REPLICATED),
+        abstract_state, param_specs, jax.eval_shape(lambda p: p, params),
         transform_non_params=lambda _: REPLICATED)

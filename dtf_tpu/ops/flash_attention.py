@@ -40,13 +40,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# 512x512 blocks: a 128x128 grid step is ~40ns of MXU work vs ~1us of grid
-# overhead, so the kernel was overhead-bound (measured ~10 TF/s flat across
-# seq lengths, ATTN_BENCH.json r3). 512x512 cuts grid steps 16x while all
-# VMEM residents (f32 scores 1MB, acc 512xd, k/v blocks) stay far under the
-# ~16MB budget. Callers can still override per-shape.
+# 512x1024 blocks, picked by the on-chip block-shape sweep (ATTN_BENCH.json
+# block_sweep, v5e, seq 8k causal fwd): 512x1024 ran 1.61 ms vs 512x512's
+# 4.44/5.76 ms, 1024x1024's 2.98 ms and 512x2048's 2.74 ms — with the bwd
+# also fastest (9.05 vs 13.4 ms). History: 128x128 was grid-overhead-bound
+# (~10 TF/s flat, r3); 512x512 fixed that (42-62 TF/s); doubling only the
+# k-extent halves the grid's inner trip count again and keeps the f32
+# score tile at [512,1024] = 2 MB, k/v residents 2x256 KB — far under the
+# ~16 MB VMEM budget. Callers can still override per-shape.
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 512
+DEFAULT_BLOCK_K = 1024
 _NEG_INF = float("-inf")
 _STAT_LANES = 128  # scratch stat arrays are [block_q, 128] (TPU lane width)
 

@@ -25,6 +25,8 @@ import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+
+from _dtf_watchdog import fence as _fence  # host-readback fence (axon-safe)
 ARTIFACT = os.path.join(ROOT, "BENCH_COST_TABLE.json")
 SENTINEL = "BENCH_COST_ROW "
 CHILD_TIMEOUT_S = 1500
@@ -41,18 +43,17 @@ def _cost(fn, *args):
         "bytes accessed", 0.0))
 
 
+
 def _time(fn, *args, iters):
     """Median-free fenced timing: warmup twice (compile + settle), then one
     readback fences ``iters`` queued executions (the bench_lm pattern)."""
-    import jax
-
     for _ in range(2):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    _fence(out)
     return (time.perf_counter() - t0) / iters
 
 
@@ -211,11 +212,11 @@ def child():
         t0 = state
         for _ in range(2):
             t0, m = step(t0, data)
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])
         t_start = time.perf_counter()
         for _ in range(iters):
             t0, m = step(t0, data)
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])
         whole["step"] = ((time.perf_counter() - t_start) / iters, 0.0, 0.0)
 
     rows = [{"component": n, "sec": None if sec is None else round(sec, 6),

@@ -64,12 +64,15 @@ def child():
     prompt = jax.numpy.asarray(
         rng.integers(0, cfg.vocab_size, (b, t_p)).astype(np.int32))
 
+    from _dtf_watchdog import fence  # host-readback fence (axon-safe)
+
     def med_timed(fn, n=3):
-        out = jax.block_until_ready(fn())                # compile + warm
+        out = fn()
+        fence(out)                                       # compile + warm
         ts = []
         for _ in range(n):
             t0 = time.perf_counter()
-            jax.block_until_ready(fn())
+            fence(fn())
             ts.append(time.perf_counter() - t0)
         return out, statistics.median(ts)
 

@@ -209,6 +209,11 @@ def child():
     else:
         row["examples_per_sec"] = round(per_sec, 1)
     if "xla_flops_per_step" in row:
+        # LOWER BOUND, not the headline: XLA's cost_analysis counts a
+        # lax.scan body ONCE (so grad-accum microbatches are under-counted
+        # by the accum factor — BERT's 0.10 vs 0.43 analytic) and Pallas
+        # custom calls report zero flops (so GPT's flash attention is
+        # excluded). mfu_analytic is the comparable convention.
         row["mfu_xla"] = round(
             row["xla_flops_per_step"] * n_steps / dt / V5E_PEAK_BF16_FLOPS, 4)
     print(SENTINEL + json.dumps(row))
@@ -242,8 +247,12 @@ def main():
         # artifact; the best combo becomes the BENCH_LM default.
         jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
                  "DTF_LM_LOSS_CHUNK": c}
-                for b, c in ((8, "0"), (8, "8192"), (16, "8192"),
+                for b, c in ((8, "0"), (16, "0"), (8, "8192"), (16, "8192"),
                              (32, "8192"), (64, "8192"))]
+        # (16, "0") added after the first on-chip sweep: chunking cost ~9
+        # MFU points at batch 8 (58.0% -> 48.9%), so the open question is
+        # whether unchunked batch 16 fits HBM — logits+cotangent ~6.6 GB —
+        # and beats 58%.
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
     elif "--sweep-bert" in sys.argv:
         # config-4 MFU levers: chunked loss, masked-position gather
@@ -256,6 +265,11 @@ def main():
              "DTF_LM_MLM_GATHER": "96"},
             {"DTF_LM_WHICH": "bert", "DTF_LM_BATCH": "64",
              "DTF_LM_LOSS_CHUNK": "8192", "DTF_LM_MLM_GATHER": "96"},
+            # gather WITHOUT chunking, added after the first on-chip sweep:
+            # chunking alone cost ~5 MFU points (44.8% -> 39.3%) while the
+            # gather won ~9 on top — the gathered head is only [B,96,V],
+            # small enough to skip chunking entirely.
+            {"DTF_LM_WHICH": "bert", "DTF_LM_MLM_GATHER": "96"},
         ]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP_BERT.json")
     elif "--phases-gpt" in sys.argv:

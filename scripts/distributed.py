@@ -54,9 +54,8 @@ def main(argv):
 
     model = mnist_model.make_model(FLAGS.model)
     # GradientDescentOptimizer equivalent; the reference used plain SGD.
-    sched = dflags.make_lr_schedule(FLAGS)
-    tx = optax.sgd(sched)
-    tx = dflags.wrap_optimizer(tx, FLAGS)
+    sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
+    tx = dflags.make_optimizer(FLAGS, optax.sgd)
     state, shardings = tr.create_train_state(
         mnist_model.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed),
         mesh)

@@ -66,9 +66,11 @@ def main(argv):
     cfg = dataclasses.replace(cfg, attn_impl=FLAGS.attn_impl)
     model, init_fn = bert.make_init(cfg, mesh if sp else None,
                                     seq_len=FLAGS.seq_len)
-    sched = dflags.make_lr_schedule(FLAGS)
-    tx = optax.adamw(sched, weight_decay=0.01)
-    tx = dflags.wrap_optimizer(tx, FLAGS)
+    sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
+    tx = dflags.make_optimizer(
+        FLAGS, lambda s: optax.adamw(s, weight_decay=(
+            FLAGS.weight_decay if FLAGS.weight_decay >= 0 else 0.01)),
+        recipe_uses_wd=True)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=bert.tp_rules, zero1=FLAGS.zero1)

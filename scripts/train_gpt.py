@@ -98,9 +98,11 @@ def main(argv):
                               attn_global_every=FLAGS.attn_global_every,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
-    sched = dflags.make_lr_schedule(FLAGS)
-    tx = optax.adamw(sched, weight_decay=0.1)
-    tx = dflags.wrap_optimizer(tx, FLAGS)
+    sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
+    tx = dflags.make_optimizer(
+        FLAGS, lambda s: optax.adamw(s, weight_decay=(
+            FLAGS.weight_decay if FLAGS.weight_decay >= 0 else 0.1)),
+        recipe_uses_wd=True)
     pipelined = mesh.shape.get("pipe", 1) > 1
     grads_fn = None   # set by --pipe_schedule=1f1b (fused fwd/bwd path)
     if pipelined:

@@ -25,7 +25,6 @@ dflags.define_train_flags(batch_size=256, learning_rate=0.1, train_steps=500,
                           lr_schedule="cosine")
 flags.DEFINE_string("config", "cifar", "cifar (ResNet-20) | imagenet "
                     "(ResNet-50)")
-flags.DEFINE_float("weight_decay", 1e-4, "L2 on conv/dense kernels")
 flags.DEFINE_integer("eval_every", 0, "run a small eval sweep every N steps "
                      "(0 = final eval only)")
 FLAGS = flags.FLAGS
@@ -54,14 +53,22 @@ def main(argv):
     else:
         model, shape, kind = resnet.resnet50(), (224, 224, 3), "imagenet"
 
-    sched = dflags.make_lr_schedule(FLAGS)
-    tx = optax.sgd(sched, momentum=0.9, nesterov=True)
-    tx = dflags.wrap_optimizer(tx, FLAGS)
+    sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
+    tx = dflags.make_optimizer(
+        FLAGS, lambda s: optax.sgd(s, momentum=0.9, nesterov=True),
+        recipe_uses_wd=True)   # consumed as loss-side L2 below
     state, shardings = tr.create_train_state(
         resnet.make_init(model, shape), tx, jax.random.PRNGKey(FLAGS.seed),
         mesh)
     step = tr.make_train_step(
-        resnet.make_loss(model, weight_decay=FLAGS.weight_decay), tx, mesh,
+        # shared --weight_decay flag (cli/flags.py): -1 = recipe default,
+        # the classic 1e-4 L2 on kernels. When --optimizer picks a
+        # decoupled-decay family the optimizer applies the decay itself,
+        # so the loss-side L2 is dropped — otherwise both would fire.
+        resnet.make_loss(model, weight_decay=(
+            0.0 if FLAGS.optimizer in ("adamw", "lamb", "adafactor")
+            else FLAGS.weight_decay if FLAGS.weight_decay >= 0 else 1e-4)),
+        tx, mesh,
         shardings, grad_accum=FLAGS.grad_accum)
 
     from dtf_tpu.data import formats

@@ -44,9 +44,8 @@ def main(argv):
 
     model = widedeep.WideDeep(hash_buckets=FLAGS.hash_buckets,
                               embed_dim=FLAGS.embed_dim)
-    sched = dflags.make_lr_schedule(FLAGS)
-    tx = optax.adam(sched)
-    tx = dflags.wrap_optimizer(tx, FLAGS)
+    sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
+    tx = dflags.make_optimizer(FLAGS, optax.adam)
     state, shardings = tr.create_train_state(
         widedeep.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=widedeep.rules)
