@@ -2,15 +2,24 @@
 # On-chip artifact pipeline (PERF.md §3c) — run the moment a chip is
 # reachable. Every step is probe-first + budget-capped, so a tunnel that
 # dies mid-pipeline costs minutes per step and leaves structured errors.
+# Order = current value density (re-ranked after the round-5 03:18 window
+# banked the full attention sweep): smoke stays first as the cheap
+# correctness gate, then everything whose rows are missing or stale —
+# the LM benches now measure the sweep-picked 512x1024 flash default
+# (expected to lift GPT past the 58.0% MFU banked on 512x512), decode +
+# cost-table re-run with the host-readback fence fix, bench.py retries
+# the headline the 04:38 tunnel death swallowed. The attention sweeps,
+# fully banked at the old default, re-run last to re-measure at the new
+# one if the window survives that long.
 set -x
 cd "$(dirname "$0")/.." || exit 1
 python scripts/tpu_smoke.py
-python scripts/bench_attention.py tpu
-python scripts/bench_attention.py tpu --sweep-blocks
 python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
-python scripts/bench_lm.py --phases-gpt
 python scripts/bench_lm.py --sweep-bert
 python scripts/bench_decode.py
 python scripts/bench_cost_table.py
 python bench.py
+python scripts/bench_lm.py --phases-gpt
+python scripts/bench_attention.py tpu
+python scripts/bench_attention.py tpu --sweep-blocks
