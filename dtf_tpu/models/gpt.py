@@ -709,25 +709,24 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     return out
 
 
-def make_eval(model: GPT, *, loss_chunk: int = 0):
+def make_eval(model: GPT, *, loss_chunk: int = 0,
+              loss_chunk_tokens: int = 0):
     """Held-out eval: mean next-token CE and perplexity (ignore -100).
 
-    ``loss_chunk``: same vocab-chunked fused-CE option as
-    :func:`make_loss` — a training run that only fits with the chunked
+    ``loss_chunk`` / ``loss_chunk_tokens``: same fused-CE options as
+    :func:`make_loss` — a training run that only fits with a chunked
     loss would otherwise OOM at its first EVAL (full [B,T,V] logits)."""
-    from dtf_tpu.ops.losses import chunked_lm_cross_entropy
+    fused = _fused_ce(loss_chunk, loss_chunk_tokens)
 
     def eval_fn(params, extra, batch):
         cfg = model.cfg
         out = model.apply({"params": params}, batch["input_ids"],
                           deterministic=True,
                           mutable=["losses"] if cfg.moe_every else False,
-                          return_hidden=loss_chunk > 0)
+                          return_hidden=fused is not None)
         y = out[0] if cfg.moe_every else out
-        if loss_chunk:
-            loss, _ = chunked_lm_cross_entropy(
-                y, params["lm_head"]["kernel"], batch["labels"],
-                chunk=loss_chunk, ignore_index=-100)
+        if fused is not None:
+            loss, _ = fused(y, params["lm_head"]["kernel"], batch["labels"])
         else:
             loss, _ = softmax_cross_entropy(y, batch["labels"],
                                             ignore_index=-100)
@@ -736,18 +735,42 @@ def make_eval(model: GPT, *, loss_chunk: int = 0):
     return eval_fn
 
 
-def make_loss(model: GPT, *, loss_chunk: int = 0):
+def _fused_ce(loss_chunk: int, loss_chunk_tokens: int):
+    """Resolve the two head-fused CE options to one callable (or None for
+    the monolithic-logits path). Vocab chunking bounds memory at
+    O(N·chunk) with an online-lse scan; token chunking bounds it at
+    O(chunk·V) with a plain CE per token block — the faster shape on
+    chip (losses.py: token_chunked_lm_cross_entropy docstring)."""
+    if loss_chunk and loss_chunk_tokens:
+        raise ValueError("loss_chunk (vocab) and loss_chunk_tokens are "
+                         "mutually exclusive — pick one chunking axis")
+    from dtf_tpu.ops.losses import (chunked_lm_cross_entropy,
+                                    token_chunked_lm_cross_entropy)
+    if loss_chunk_tokens:
+        return lambda y, w, lab: token_chunked_lm_cross_entropy(
+            y, w, lab, chunk=loss_chunk_tokens, ignore_index=-100)
+    if loss_chunk:
+        return lambda y, w, lab: chunked_lm_cross_entropy(
+            y, w, lab, chunk=loss_chunk, ignore_index=-100)
+    return None
+
+
+def make_loss(model: GPT, *, loss_chunk: int = 0,
+              loss_chunk_tokens: int = 0):
     """Next-token CE: batch = {"input_ids" [B,T], "labels" [B,T]} where
     labels are input_ids shifted left by the data layer (-100 = ignore).
 
     ``loss_chunk > 0``: compute CE fused with the lm_head in vocab chunks
     of that width (:func:`dtf_tpu.ops.losses.chunked_lm_cross_entropy`) —
     identical numbers, O(N·chunk) instead of O(N·V) live logits memory
-    (the single-chip batch-size ceiling for a 50k vocab). Composes with
-    DP/SP; under TP (lm_head sharded over 'model') prefer the standard
-    path — the chunk slices fight the vocab sharding.
+    (the single-chip batch-size ceiling for a 50k vocab).
+    ``loss_chunk_tokens > 0``: chunk TOKENS instead — O(chunk·V) live
+    logits and one full-vocab MXU matmul per block, the faster chunking
+    axis on chip (:func:`~dtf_tpu.ops.losses.token_chunked_lm_cross_entropy`).
+    Both compose with DP/SP; under TP (lm_head sharded over 'model')
+    prefer the standard path — chunk slices fight the vocab sharding.
     """
-    from dtf_tpu.ops.losses import chunked_lm_cross_entropy
+    fused = _fused_ce(loss_chunk, loss_chunk_tokens)
 
     def loss_fn(params, extra, batch, rng):
         cfg = model.cfg
@@ -756,12 +779,10 @@ def make_loss(model: GPT, *, loss_chunk: int = 0):
             deterministic=cfg.dropout == 0.0,
             rngs={"dropout": rng} if cfg.dropout else {},
             mutable=["losses"] if cfg.moe_every else False,
-            return_hidden=loss_chunk > 0)
+            return_hidden=fused is not None)
         y, mut = out if cfg.moe_every else (out, {})
-        if loss_chunk:
-            loss, n = chunked_lm_cross_entropy(
-                y, params["lm_head"]["kernel"], batch["labels"],
-                chunk=loss_chunk, ignore_index=-100)
+        if fused is not None:
+            loss, n = fused(y, params["lm_head"]["kernel"], batch["labels"])
         else:
             loss, n = softmax_cross_entropy(y, batch["labels"],
                                             ignore_index=-100)

@@ -109,3 +109,73 @@ def chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
     (m, s, tgt), _ = jax.lax.scan(body, init, jnp.arange(n_chunks))
     ce = (m + jnp.log(s)) - tgt                 # [N]
     return _masked_mean(ce.reshape(lead), labels, ignore_index)
+
+
+def token_chunked_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
+                                   labels: jax.Array, *, chunk: int = 4096,
+                                   bias: jax.Array | None = None,
+                                   ignore_index: int | None = None,
+                                   ) -> tuple[jax.Array, jax.Array]:
+    """Fused head+CE chunking TOKENS instead of vocab columns.
+
+    Same memory guarantee as :func:`chunked_lm_cross_entropy` — live
+    logits are O(chunk·V) instead of O(N·V) — but each scan step is ONE
+    full-vocab matmul ([chunk, D] x [D, V]) followed by a plain CE, with
+    no online-logsumexp carry. The round-5 on-chip rows showed the
+    vocab-chunked scan costs ~9 GPT MFU points over the monolithic loss
+    (BENCH_LM_SWEEP.json; PERF.md §0b): its per-step [N, chunk] max/
+    rescale/pick passes are VPU traffic over the whole activation set
+    repeated every chunk, and its carries serialize against the matmul.
+    Token chunking does the lse/pick arithmetic ONCE per token on an
+    MXU-shaped [chunk, V] tile, so it should sit between the monolithic
+    and vocab-chunked points at the same bounded memory. Chunk the vocab
+    instead when the HEAD matmul itself must stay narrow (e.g. a [D, V]
+    too big to tile comfortably — not the case at GPT-2 scale).
+
+    Semantics identical to :func:`softmax_cross_entropy` (same
+    ignore/mean tail, same out-of-range-label behavior). ``w_head``
+    [D, V]; each chunk's logits are rematerialized in the backward
+    (``jax.checkpoint``), so the cotangent is also O(chunk·V).
+    """
+    d = x.shape[-1]
+    v = w_head.shape[1]
+    xf = x.reshape(-1, d)
+    lab = labels.reshape(-1)
+    n = xf.shape[0]
+    n_chunks = -(-n // chunk)
+    n_pad = n_chunks * chunk
+    live = jnp.arange(n_pad) < n                # padded rows contribute 0
+    if n_pad != n:
+        xf = jnp.pad(xf, ((0, n_pad - n), (0, 0)))
+        lab = jnp.pad(lab, (0, n_pad - n))
+    bf = None if bias is None else bias.astype(jnp.float32)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        tot, cnt = carry
+        xc, lc, rowc = inp                      # [chunk,D], [chunk], [chunk]
+        logits = jnp.dot(xc, w_head,
+                         preferred_element_type=jnp.float32)  # [chunk, V]
+        if bf is not None:
+            logits = logits + bf[None, :]
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = rowc if ignore_index is None else (
+            rowc & (lc != ignore_index))
+        safe = jnp.where(valid, lc, 0)
+        # iota-compare pick (the vocab-chunked path's pattern): fuses to a
+        # masked reduce with no materialized [chunk, V] f32 one_hot. An
+        # out-of-range label matches no column -> picked 0, the exact
+        # full-path behavior (softmax_cross_entropy above).
+        col = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        picked = jnp.sum(
+            jnp.where(col == safe[:, None], logits, 0.0), axis=-1)
+        ce = jnp.where(valid, lse - picked, 0.0)
+        return (tot + ce.sum(), cnt + valid.sum(dtype=jnp.float32)), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.float32(0.0)),
+        (xf.reshape(n_chunks, chunk, d), lab.reshape(n_chunks, chunk),
+         live.reshape(n_chunks, chunk)))
+    # same clamped-count contract as _masked_mean (all-ignored -> 0.0)
+    cnt = jnp.maximum(cnt, 1.0)
+    return tot / cnt, cnt

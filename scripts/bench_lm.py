@@ -101,7 +101,9 @@ def child():
             init_fn, tx, jax.random.PRNGKey(0), mesh,
             param_rules=gpt.tp_rules, zero1=True)
         lchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK", "0"))
-        loss_fn = gpt.make_loss(model, loss_chunk=lchunk)
+        tchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK_T", "0"))
+        loss_fn = gpt.make_loss(model, loss_chunk=lchunk,
+                                loss_chunk_tokens=tchunk)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   log_grad_norm=False)
         data = shard_batch(
@@ -109,7 +111,7 @@ def child():
                           vocab_size=cfg.vocab_size).batch(0), mesh)
         row.update(batch=batch, seq=seq, attn="flash(auto)",
                    n_params=int(_count_params(state.params)), zero1=True,
-                   loss_chunk=lchunk)
+                   loss_chunk=lchunk, loss_chunk_tokens=tchunk)
         unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
@@ -253,6 +255,12 @@ def main():
         # MFU points at batch 8 (58.0% -> 48.9%), so the open question is
         # whether unchunked batch 16 fits HBM — logits+cotangent ~6.6 GB —
         # and beats 58%.
+        # Token-chunked rows (round 5): the chunking axis that keeps the
+        # per-step matmul full-vocab; expected between the monolithic and
+        # vocab-chunked points at the same bounded memory.
+        jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
+                  "DTF_LM_LOSS_CHUNK_T": "4096"}
+                 for b in (8, 16, 32)]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
     elif "--sweep-bert" in sys.argv:
         # config-4 MFU levers: chunked loss, masked-position gather

@@ -352,6 +352,45 @@ def test_chunked_lm_cross_entropy_matches_full():
                                    rtol=2e-5, atol=2e-6)
 
 
+def test_token_chunked_lm_cross_entropy_matches_full():
+    """Token-chunked fused CE == full-logits CE (loss, count, grads wrt x
+    AND w), incl. ignore_index, a token count that doesn't divide the
+    chunk (pad rows must contribute nothing), bias, and out-of-range
+    labels — the same contract the vocab-chunked path proves above."""
+    from dtf_tpu.ops.losses import (softmax_cross_entropy,
+                                    token_chunked_lm_cross_entropy)
+
+    ks = jax.random.split(jax.random.PRNGKey(7), 4)
+    x = jax.random.normal(ks[0], (3, 5, 16), jnp.float32)  # N=15: pad to 16
+    w = jax.random.normal(ks[1], (16, 103), jnp.float32)
+    bias = jax.random.normal(ks[3], (103,), jnp.float32)
+    labels = jax.random.randint(ks[2], (3, 5), 0, 103)
+    labels = labels.at[0, 1].set(-100).at[2, 3].set(-100)
+    labels = labels.at[1, 4].set(200)  # out of range: picks nothing
+
+    def full(x, w):
+        return softmax_cross_entropy(x @ w + bias, labels, ignore_index=-100)
+
+    def chunked(x, w):
+        return token_chunked_lm_cross_entropy(
+            x, w, labels, chunk=8, bias=bias, ignore_index=-100)
+
+    (lf, nf), (lc, nc) = full(x, w), chunked(x, w)
+    np.testing.assert_allclose(float(lc), float(lf), rtol=1e-6)
+    assert float(nc) == float(nf) == 13.0
+    gf = jax.grad(lambda x, w: full(x, w)[0], (0, 1))(x, w)
+    gc = jax.grad(lambda x, w: chunked(x, w)[0], (0, 1))(x, w)
+    for a, b in zip(gc, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+    # no-ignore path: mean over every token, count = N (padded rows out)
+    (lf2, nf2) = softmax_cross_entropy(x @ w + bias, jnp.abs(labels) % 103)
+    (lc2, nf2c) = token_chunked_lm_cross_entropy(
+        x, w, jnp.abs(labels) % 103, chunk=8, bias=bias)
+    np.testing.assert_allclose(float(lc2), float(lf2), rtol=1e-6)
+    assert float(nf2c) == float(nf2) == 15.0
+
+
 def test_chunked_lm_cross_entropy_out_of_range_label_finite():
     """A label in the pad band [V, V_pad) must not pick a padded -inf
     column (ADVICE r4): both CE paths treat any out-of-range label as

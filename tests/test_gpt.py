@@ -204,6 +204,12 @@ def test_gpt_chunked_loss_matches_full(mesh8):
     chunked, _ = gpt.make_loss(model, loss_chunk=48)(
         state.params, state.extra, batch, rng)
     np.testing.assert_allclose(float(chunked), float(full), rtol=1e-6)
+    # token chunk 24 does not divide B*T — exercises the padded rows
+    tchunked, _ = gpt.make_loss(model, loss_chunk_tokens=24)(
+        state.params, state.extra, batch, rng)
+    np.testing.assert_allclose(float(tchunked), float(full), rtol=1e-6)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        gpt.make_loss(model, loss_chunk=48, loss_chunk_tokens=24)
 
 
 def test_gpt_remat_same_loss(mesh8):
