@@ -455,6 +455,12 @@ def _bwd(q, k, v, mask_bias, out, lse, do, *, sm_scale, causal, window,
     kp, vp = _pad(k, block_k, 1), _pad(v, block_k, 1)
     lsep = _pad(lse, block_q, 1).reshape(bh, num_q, 1, block_q)
     deltap = _pad(delta, block_q, 1).reshape(bh, num_q, 1, block_q)
+    if has_mask:
+        # The residual bias arrived padded to the FORWARD block_k; when
+        # the bwd runs its own block_k the k-grid may cover more columns
+        # than that pad — slice back to t_k and re-pad for THIS grid, or
+        # the last mask block reads out of bounds.
+        mask_bias = _pad(mask_bias[:, :, :t_k], block_k, 2)
     mask_in = [mask_bias] if has_mask else []
     heads = bh // mask_bias.shape[0] if has_mask else 1  # bias is per-batch
 
@@ -535,9 +541,10 @@ def _pad(x, multiple, axis):
     return jnp.pad(x, widths)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, mask_bias, causal, window, sm_scale, block_q, block_k,
-           interpret, block_h):
+           interpret, block_h, block_q_bwd, block_k_bwd):
     out, _ = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
                   window=window, block_q=block_q, block_k=block_k,
                   interpret=interpret, block_h=block_h)
@@ -545,7 +552,7 @@ def _flash(q, k, v, mask_bias, causal, window, sm_scale, block_q, block_k,
 
 
 def _flash_fwd(q, k, v, mask_bias, causal, window, sm_scale, block_q,
-               block_k, interpret, block_h):
+               block_k, interpret, block_h, block_q_bwd, block_k_bwd):
     out, lse = _fwd(q, k, v, mask_bias, sm_scale=sm_scale, causal=causal,
                     window=window, block_q=block_q, block_k=block_k,
                     interpret=interpret, block_h=block_h)
@@ -553,12 +560,17 @@ def _flash_fwd(q, k, v, mask_bias, causal, window, sm_scale, block_q,
 
 
 def _flash_bwd(causal, window, sm_scale, block_q, block_k, interpret,
-               block_h, res, do):
+               block_h, block_q_bwd, block_k_bwd, res, do):
     del block_h  # fwd-only lever; the backward keeps the proven 2-D grids
     q, k, v, mask_bias, out, lse = res
+    # The backward's two grids stream the OPPOSITE extents from the
+    # forward (_dq scans k; _dkv scans q), so the fwd-optimal block shape
+    # need not be bwd-optimal — 0 inherits the fwd blocks, the sweep
+    # (bench_attention --sweep-blocks bwd rows) picks better ones.
     dq, dk, dv = _bwd(q, k, v, mask_bias, out, lse, do, sm_scale=sm_scale,
-                      causal=causal, window=window, block_q=block_q,
-                      block_k=block_k, interpret=interpret)
+                      causal=causal, window=window,
+                      block_q=block_q_bwd or block_q,
+                      block_k=block_k_bwd or block_k, interpret=interpret)
     dmb = None if mask_bias is None else jnp.zeros_like(mask_bias)
     return dq, dk, dv, dmb
 
@@ -620,6 +632,8 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     block_h: int = 1,
+                    block_q_bwd: int = 0,
+                    block_k_bwd: int = 0,
                     interpret: bool = False) -> jax.Array:
     """Fused attention. [B, H, T, D] → [B, H, T, D]; differentiable.
 
@@ -639,6 +653,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     step — batched MXU contractions amortize the fixed per-step overhead
     (see :func:`_fwd_kernel_hfold`). Must divide ``heads``. Forward only;
     the backward keeps its proven 2-D grids.
+
+    ``block_q_bwd`` / ``block_k_bwd`` (opt-in, 0 = inherit the fwd
+    blocks): separate block shape for the two backward kernels. The
+    backward streams the opposite extents from the forward (``_dq``
+    scans k-blocks, ``_dkv`` scans q-blocks), so the sweep-picked fwd
+    shape is not necessarily bwd-optimal; ``bench_attention.py
+    --sweep-blocks`` measures the bwd rows on chip.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, H, T, D], got shape {q.shape}")
@@ -663,5 +684,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 f"kv_mask shape {kv_mask.shape} != (batch, t_k)=({b}, {t_k})")
         mask_bias = _mask_bias(kv_mask, b, t_k, block_k)
     out = _flash(qr, kr, vr, mask_bias, causal, int(window), scale,
-                 block_q, block_k, interpret, int(block_h))
+                 block_q, block_k, interpret, int(block_h),
+                 min(block_q_bwd, max(t_q, 1)),
+                 min(block_k_bwd, max(t_k, 1)))
     return out.reshape(b, h, t_q, d)

@@ -184,6 +184,8 @@ def tpu_child():
     blk_q = int(os.environ.get("DTF_ATTN_BQ", "0"))
     blk_k = int(os.environ.get("DTF_ATTN_BK", "0"))
     blk_h = int(os.environ.get("DTF_ATTN_BH", "0"))  # head fold (fwd only)
+    blk_qb = int(os.environ.get("DTF_ATTN_BQB", "0"))  # bwd-only blocks
+    blk_kb = int(os.environ.get("DTF_ATTN_BKB", "0"))
     # CPU CI pin: interpret-mode run of this exact child (tiny seq) so a
     # wiring typo can't surface for the first time on the chip
     interp = os.environ.get("DTF_ATTN_INTERPRET") == "1"
@@ -240,6 +242,10 @@ def tpu_child():
         blk_kw["block_k"] = blk_k
     if blk_h:
         blk_kw["block_h"] = blk_h
+    if blk_qb:
+        blk_kw["block_q_bwd"] = blk_qb
+    if blk_kb:
+        blk_kw["block_k_bwd"] = blk_kb
     flash = lambda q, k, v: fa.flash_attention(  # noqa: E731
         q, k, v, causal=True, interpret=interp, **blk_kw)
     dense = lambda q, k, v: att.dense_attention(  # noqa: E731
@@ -262,7 +268,7 @@ def tpu_child():
            "reps_fwd": r_fwd, "reps_fwdbwd": r_bwd,
            "block_q": min(blk_q or fa.DEFAULT_BLOCK_Q, t),
            "block_k": min(blk_k or fa.DEFAULT_BLOCK_K, t),
-           "block_h": blk_h or 1}
+           "block_h": blk_h or 1, "block_q_bwd": blk_qb, "block_k_bwd": blk_kb}
     row["flash_fwd_s"] = round(scan_timed(fwd_step(flash), q, r_fwd), 6)
     row["flash_fwdbwd_s"] = round(scan_timed(fwdbwd_step(flash), q, r_bwd), 6)
     if t >= 4096:
@@ -323,6 +329,18 @@ def tpu_main():
                     (1024, 512, 1), (1024, 1024, 1), (512, 2048, 1),
                     # head folding (fwd): amortize per-grid-step overhead
                     (512, 512, 2), (512, 512, 4), (1024, 1024, 2))]
+        # bwd-only block rows (round 5): fwd pinned at its sweep winner
+        # (512x1024 — now the default), vary ONLY the backward blocks.
+        # The bwd ran ~92 TF/s vs fwd's ~170 in the round-5 window; its
+        # grids stream the opposite extents, so the optimum may differ.
+        # (512, 1024) duplicates the inherited fwd default on purpose: a
+        # same-window control row, so bwd deltas are read against a
+        # baseline measured in THIS window, not one from a different
+        # tunnel session.
+        jobs += [{"DTF_ATTN_SEQ": "8192",
+                  "DTF_ATTN_BQB": str(bqb), "DTF_ATTN_BKB": str(bkb)}
+                 for bqb, bkb in ((512, 512), (1024, 512), (512, 1024),
+                                  (1024, 1024), (256, 1024))]
 
         def on_result(row, job, rows, errs):
             tpu = _read_artifact().get("tpu", {})

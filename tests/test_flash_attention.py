@@ -72,6 +72,45 @@ def test_grads_match_dense_unaligned():
         np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.parametrize("bqb,bkb", [(16, 64), (64, 16), (64, 64)])
+def test_bwd_blocks_differ_from_fwd(bqb, bkb):
+    """block_q_bwd/block_k_bwd reshape ONLY the backward grids: forward
+    output and all three grads must match dense with bwd blocks unlike
+    the fwd ones (incl. unaligned T so both pads differ), causal+window."""
+    b, h, t, d = 1, 2, 83, 16
+    q, k, v = (_rand((b, h, t, d), jnp.float32, 30 + i) for i in range(3))
+    g = _rand((b, h, t, d), jnp.float32, 77)
+
+    # kv_mask included: the residual bias is padded to the FWD block_k and
+    # must be re-padded for the bwd grid (the review-found OOB read)
+    kv_mask = jnp.arange(t)[None, :] < (t - 7)
+    for kw in ({"causal": True}, {"causal": True, "window": 24},
+               {"kv_mask": kv_mask}):
+        dense_kw = (dict(kw) if "kv_mask" not in kw
+                    else {"bias": jnp.where(kv_mask, 0.0, -jnp.inf)[
+                        :, None, None, :]})
+
+        def loss_flash(q, k, v):
+            out = flash_attention(q, k, v, block_q=32, block_k=32,
+                                  block_q_bwd=bqb, block_k_bwd=bkb,
+                                  interpret=True, **kw)
+            return jnp.sum(out * g)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, **dense_kw) * g)
+
+        np.testing.assert_allclose(
+            flash_attention(q, k, v, block_q=32, block_k=32,
+                            block_q_bwd=bqb, block_k_bwd=bkb,
+                            interpret=True, **kw),
+            dense_attention(q, k, v, **dense_kw), atol=2e-5, rtol=2e-5)
+        grads_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        grads_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gf, gd, name in zip(grads_f, grads_d, "qkv"):
+            np.testing.assert_allclose(gf, gd, atol=1e-4, rtol=1e-4,
+                                       err_msg=f"d{name} {kw}")
+
+
 def test_bf16_close_to_f32_dense():
     b, h, t, d = 2, 2, 64, 32
     qf, kf, vf = (_rand((b, h, t, d), jnp.float32, 30 + i) for i in range(3))

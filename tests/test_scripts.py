@@ -166,7 +166,8 @@ def test_bench_attention_tpu_child_interpret_mode():
     env = _env()
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env.update(DTF_ATTN_SEQ="256", DTF_ATTN_BQ="64", DTF_ATTN_BK="64",
-               DTF_ATTN_BH="2", DTF_ATTN_INTERPRET="1")
+               DTF_ATTN_BH="2", DTF_ATTN_BQB="128", DTF_ATTN_BKB="64",
+               DTF_ATTN_INTERPRET="1")
     proc = subprocess.run(
         [sys.executable,
          os.path.join(ROOT, "scripts", "bench_attention.py"), "tpu",
