@@ -52,6 +52,17 @@ def read_idx(path: str) -> np.ndarray:
         return data.reshape(dims)
 
 
+def write_idx(path: str, arr: np.ndarray, *, gz: bool = False) -> None:
+    """Write a uint8 array as an IDX file (read_idx's inverse). The one
+    shared writer — tests and benchmarks must not re-implement the header
+    packing."""
+    arr = np.asarray(arr, np.uint8)
+    header = struct.pack(f">I{arr.ndim}I", 0x0800 | arr.ndim, *arr.shape)
+    opener = gzip.open if gz else open
+    with opener(path + (".gz" if gz else ""), "wb") as f:
+        f.write(header + arr.tobytes())
+
+
 def available(data_dir: str) -> bool:
     return all(
         os.path.exists(os.path.join(data_dir, f))

@@ -41,11 +41,9 @@ def test_synthetic_rejects_bad_config():
 
 
 def _write_idx(path, arr, gz=False):
-    arr = np.asarray(arr, np.uint8)
-    header = struct.pack(f">I{arr.ndim}I", 0x0800 | arr.ndim, *arr.shape)
-    opener = gzip.open if gz else open
-    with opener(path + (".gz" if gz else ""), "wb") as f:
-        f.write(header + arr.tobytes())
+    from dtf_tpu.data.mnist import write_idx
+
+    write_idx(path, arr, gz=gz)
 
 
 @pytest.fixture

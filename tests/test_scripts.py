@@ -274,3 +274,31 @@ def test_bench_cost_table_child_tiny_mode(which):
     assert row["fwd_sec"] > 0 and row["fwdbwd_sec"] > row["fwd_sec"]
     assert all(c["sec"] > 0 and c["xla_flops"] > 0
                for c in row["components"])
+
+
+def test_bench_io_tiny_mode():
+    """CI-pin the host-side IO bench (bench_io.py): python + native rows
+    emit for both the IDX epoch path and TFRecord indexing, so the
+    artifact run can't be the first execution of this code. No jax, no
+    device — plain host subprocess."""
+    from dtf_tpu.data.native import native_available
+
+    if not native_available():
+        pytest.skip("no C++ toolchain")  # bench still runs, python-only
+    env = dict(os.environ)
+    env["DTF_IO_TINY"] = "1"
+    env["PYTHONPATH"] = ROOT
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_io.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    import json
+
+    row = json.loads(proc.stdout.splitlines()[-1])
+    assert row["tiny"] is True
+    assert row["idx_epoch"]["python_images_per_sec"] > 0
+    assert row["idx_epoch"]["native_images_per_sec"] > 0
+    tf = row["tfrecord_index"]
+    assert tf["python_index_mb_per_sec"] > 0
+    assert tf["native_index_mb_per_sec"] > 0
+    assert tf["native_verifies_payload_crc"] is True
