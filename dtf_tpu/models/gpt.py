@@ -710,13 +710,15 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
 
 
 def make_eval(model: GPT, *, loss_chunk: int = 0,
-              loss_chunk_tokens: int = 0):
+              loss_chunk_tokens: int = 0, loss_pallas: bool = False):
     """Held-out eval: mean next-token CE and perplexity (ignore -100).
 
-    ``loss_chunk`` / ``loss_chunk_tokens``: same fused-CE options as
-    :func:`make_loss` — a training run that only fits with a chunked
-    loss would otherwise OOM at its first EVAL (full [B,T,V] logits)."""
-    fused = _fused_ce(loss_chunk, loss_chunk_tokens)
+    ``loss_chunk`` / ``loss_chunk_tokens`` / ``loss_pallas``: same
+    fused-CE options as :func:`make_loss` — a training run that only
+    fits with a fused loss would otherwise OOM at its first EVAL (full
+    [B,T,V] logits)."""
+    fused = _fused_ce(loss_chunk, loss_chunk_tokens, loss_pallas,
+                      model.mesh)
 
     def eval_fn(params, extra, batch):
         cfg = model.cfg
@@ -735,17 +737,33 @@ def make_eval(model: GPT, *, loss_chunk: int = 0,
     return eval_fn
 
 
-def _fused_ce(loss_chunk: int, loss_chunk_tokens: int):
-    """Resolve the two head-fused CE options to one callable (or None for
+def _fused_ce(loss_chunk: int, loss_chunk_tokens: int,
+              loss_pallas: bool = False, mesh=None):
+    """Resolve the head-fused CE options to one callable (or None for
     the monolithic-logits path). Vocab chunking bounds memory at
     O(N·chunk) with an online-lse scan; token chunking bounds it at
     O(chunk·V) with a plain CE per token block — the faster shape on
-    chip (losses.py: token_chunked_lm_cross_entropy docstring)."""
-    if loss_chunk and loss_chunk_tokens:
-        raise ValueError("loss_chunk (vocab) and loss_chunk_tokens are "
-                         "mutually exclusive — pick one chunking axis")
+    chip (losses.py: token_chunked_lm_cross_entropy docstring); the
+    pallas kernel keeps logits in VMEM tiles entirely (ops/fused_ce.py
+    — the flash-attention move applied to the LM head)."""
+    if sum(map(bool, (loss_chunk, loss_chunk_tokens, loss_pallas))) > 1:
+        raise ValueError("loss_chunk (vocab), loss_chunk_tokens and "
+                         "loss_pallas are mutually exclusive")
     from dtf_tpu.ops.losses import (chunked_lm_cross_entropy,
                                     token_chunked_lm_cross_entropy)
+    if loss_pallas:
+        from dtf_tpu.ops.fused_ce import pallas_lm_cross_entropy_sharded
+
+        def pallas_ce(y, w, lab):
+            # the shard_map boundary lives in the op (like flash's
+            # _sharded variants): a bare pallas_call under jit would
+            # all-gather the DP/SP-sharded tokens and run redundantly
+            mean, n = pallas_lm_cross_entropy_sharded(
+                y, w, lab, mesh, ignore_index=-100,
+                interpret=jax.default_backend() != "tpu")
+            return mean, n
+
+        return pallas_ce
     if loss_chunk_tokens:
         return lambda y, w, lab: token_chunked_lm_cross_entropy(
             y, w, lab, chunk=loss_chunk_tokens, ignore_index=-100)
@@ -756,7 +774,7 @@ def _fused_ce(loss_chunk: int, loss_chunk_tokens: int):
 
 
 def make_loss(model: GPT, *, loss_chunk: int = 0,
-              loss_chunk_tokens: int = 0):
+              loss_chunk_tokens: int = 0, loss_pallas: bool = False):
     """Next-token CE: batch = {"input_ids" [B,T], "labels" [B,T]} where
     labels are input_ids shifted left by the data layer (-100 = ignore).
 
@@ -767,10 +785,13 @@ def make_loss(model: GPT, *, loss_chunk: int = 0,
     ``loss_chunk_tokens > 0``: chunk TOKENS instead — O(chunk·V) live
     logits and one full-vocab MXU matmul per block, the faster chunking
     axis on chip (:func:`~dtf_tpu.ops.losses.token_chunked_lm_cross_entropy`).
-    Both compose with DP/SP; under TP (lm_head sharded over 'model')
+    ``loss_pallas``: the Pallas fused head+CE kernel — logits live only
+    in VMEM tiles (:mod:`dtf_tpu.ops.fused_ce`).
+    All compose with DP/SP; under TP (lm_head sharded over 'model')
     prefer the standard path — chunk slices fight the vocab sharding.
     """
-    fused = _fused_ce(loss_chunk, loss_chunk_tokens)
+    fused = _fused_ce(loss_chunk, loss_chunk_tokens, loss_pallas,
+                      model.mesh)
 
     def loss_fn(params, extra, batch, rng):
         cfg = model.cfg

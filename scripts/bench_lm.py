@@ -102,8 +102,10 @@ def child():
             param_rules=gpt.tp_rules, zero1=True)
         lchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK", "0"))
         tchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK_T", "0"))
+        lpallas = os.environ.get("DTF_LM_LOSS_PALLAS") == "1"
         loss_fn = gpt.make_loss(model, loss_chunk=lchunk,
-                                loss_chunk_tokens=tchunk)
+                                loss_chunk_tokens=tchunk,
+                                loss_pallas=lpallas)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   log_grad_norm=False)
         data = shard_batch(
@@ -111,7 +113,8 @@ def child():
                           vocab_size=cfg.vocab_size).batch(0), mesh)
         row.update(batch=batch, seq=seq, attn="flash(auto)",
                    n_params=int(_count_params(state.params)), zero1=True,
-                   loss_chunk=lchunk, loss_chunk_tokens=tchunk)
+                   loss_chunk=lchunk, loss_chunk_tokens=tchunk,
+                   loss_pallas=lpallas)
         unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
@@ -260,6 +263,10 @@ def main():
         # vocab-chunked points at the same bounded memory.
         jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
                   "DTF_LM_LOSS_CHUNK_T": "4096"}
+                 for b in (8, 16, 32)]
+        # Pallas fused head+CE rows: logits never leave VMEM
+        jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
+                  "DTF_LM_LOSS_PALLAS": "1"}
                  for b in (8, 16, 32)]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
     elif "--sweep-bert" in sys.argv:

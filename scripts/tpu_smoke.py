@@ -187,6 +187,36 @@ def child():
     ok &= record("chunked_prefill_decode", chk.astype(jnp.float32),
                  one.astype(jnp.float32), tol=0.0)
 
+    # --- pallas fused head+CE fwd+bwd vs full-logits path (round 5) ---
+    from dtf_tpu.ops.fused_ce import pallas_lm_cross_entropy
+    from dtf_tpu.ops.losses import softmax_cross_entropy
+
+    kx, kw2, kl = jax.random.split(kd, 3)
+    xc = jax.random.normal(kx, (4, 256, 128), jnp.bfloat16)
+    wc = jax.random.normal(kw2, (128, 1000), jnp.float32) * 0.05
+    labc = jax.random.randint(kl, (4, 256), 0, 1000)
+    labc = labc.at[0, :10].set(-100)   # ignored band
+
+    def loss_fused(x, w):
+        return pallas_lm_cross_entropy(x, w, labc, ignore_index=-100,
+                                       block_n=256, block_v=256,
+                                       interpret=False)[0]
+
+    def loss_full(x, w):
+        return softmax_cross_entropy(x.astype(jnp.float32) @ w, labc,
+                                     ignore_index=-100)[0]
+
+    lf_, gf_ = jax.jit(jax.value_and_grad(loss_fused, argnums=(0, 1)))(
+        xc, wc)
+    with jax.default_matmul_precision("highest"):
+        ld_, gd_ = jax.jit(jax.value_and_grad(loss_full, argnums=(0, 1)))(
+            xc, wc)
+    ok &= record("fused_ce_fwd", jnp.asarray(lf_), jnp.asarray(ld_),
+                 tol=2e-2)
+    ok &= record("fused_ce_bwd_dx", gf_[0].astype(jnp.float32),
+                 gd_[0].astype(jnp.float32), tol=5e-2)
+    ok &= record("fused_ce_bwd_dw", gf_[1], gd_[1], tol=5e-2)
+
     results["ok"] = bool(ok) and backend == "tpu"
     if backend != "tpu":
         results["note"] = (f"ran on backend={backend}; not a TPU-compiled "

@@ -66,6 +66,10 @@ flags.DEFINE_integer("loss_chunk_tokens", 0, "fused LM loss chunking "
                      "the faster chunking axis on chip (PERF.md 0b). "
                      "Mutually exclusive with --loss_chunk_vocab; same "
                      "--mesh_model/--mesh_pipe restrictions")
+flags.DEFINE_boolean("loss_pallas", False, "Pallas fused head+CE kernel: "
+                     "logits never leave VMEM (dtf_tpu/ops/fused_ce.py). "
+                     "Mutually exclusive with the chunked-loss flags; "
+                     "same --mesh_model/--mesh_pipe restrictions")
 flags.DEFINE_integer("eval_every", 0, "held-out eval (val.bin or held-out "
                      "synthetic) every N steps; 0 = final eval only. On the "
                      "pipelined path the eval step runs un-pipelined "
@@ -109,20 +113,22 @@ def main(argv):
         FLAGS, lambda s: optax.adamw(s, weight_decay=(
             FLAGS.weight_decay if FLAGS.weight_decay >= 0 else 0.1)),
         recipe_uses_wd=True)
-    if FLAGS.loss_chunk_vocab and FLAGS.loss_chunk_tokens:
+    if sum(map(bool, (FLAGS.loss_chunk_vocab, FLAGS.loss_chunk_tokens,
+                      FLAGS.loss_pallas))) > 1:
         raise app.UsageError(
-            "--loss_chunk_vocab and --loss_chunk_tokens are mutually "
-            "exclusive — pick one chunking axis")
+            "--loss_chunk_vocab, --loss_chunk_tokens and --loss_pallas "
+            "are mutually exclusive — pick one fused-loss strategy")
     pipelined = mesh.shape.get("pipe", 1) > 1
     grads_fn = None   # set by --pipe_schedule=1f1b (fused fwd/bwd path)
     if pipelined:
         from dtf_tpu.models import gpt_pipe
 
-        if FLAGS.loss_chunk_vocab or FLAGS.loss_chunk_tokens:
+        if (FLAGS.loss_chunk_vocab or FLAGS.loss_chunk_tokens
+                or FLAGS.loss_pallas):
             raise app.UsageError(
-                "--loss_chunk_vocab/--loss_chunk_tokens are not supported "
-                "with --mesh_pipe (the pipelined loss owns its head "
-                "application); use them on the non-pipelined path")
+                "--loss_chunk_vocab/--loss_chunk_tokens/--loss_pallas are "
+                "not supported with --mesh_pipe (the pipelined loss owns "
+                "its head application); use them on the non-pipelined path")
         tp_in_pipe = mesh.shape.get("model", 1) > 1
         if sp and tp_in_pipe:
             raise app.UsageError(
@@ -197,19 +203,21 @@ def main(argv):
     else:
         # the model needs the mesh for ring attention (seq axis) AND for the
         # shard_map'd flash kernel (model axis) — pass it unconditionally.
-        if ((FLAGS.loss_chunk_vocab or FLAGS.loss_chunk_tokens)
-                and mesh.shape.get("model", 1) > 1):
+        if ((FLAGS.loss_chunk_vocab or FLAGS.loss_chunk_tokens
+             or FLAGS.loss_pallas) and mesh.shape.get("model", 1) > 1):
             raise app.UsageError(
-                "--loss_chunk_vocab/--loss_chunk_tokens cannot combine "
-                "with --mesh_model: TP shards the lm_head over the vocab "
-                "dim, which chunked application would fight "
+                "--loss_chunk_vocab/--loss_chunk_tokens/--loss_pallas "
+                "cannot combine with --mesh_model: TP shards the lm_head "
+                "over the vocab dim, which fused application would fight "
                 "(all-gathering W per chunk)")
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
         loss_fn = gpt.make_loss(model, loss_chunk=FLAGS.loss_chunk_vocab,
-                                loss_chunk_tokens=FLAGS.loss_chunk_tokens)
+                                loss_chunk_tokens=FLAGS.loss_chunk_tokens,
+                                loss_pallas=FLAGS.loss_pallas)
         param_rules = gpt.tp_rules
         eval_fn = gpt.make_eval(model, loss_chunk=FLAGS.loss_chunk_vocab,
-                                loss_chunk_tokens=FLAGS.loss_chunk_tokens)
+                                loss_chunk_tokens=FLAGS.loss_chunk_tokens,
+                                loss_pallas=FLAGS.loss_pallas)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=param_rules, zero1=FLAGS.zero1)
