@@ -81,6 +81,13 @@ class GPTConfig:
     #: >0 enables single-token decode mode with a KV cache of this length
     #: (the "cache" collection; see :func:`generate`).
     decode_len: int = 0
+    #: "" = store K/V at ``dtype`` (bf16); "int8" = symmetric per-slot
+    #: per-head quantization (amax over d_head -> one f32 scale per
+    #: [b, kv_head, slot]): the cache holds HALF the bytes — the third
+    #: serving memory lever, multiplicative with GQA (heads/kv_heads) and
+    #: the rolling window (decode_len/window). Dequantized at read; the
+    #: scale adds 1/d_head overhead (~0.8% at d_head=64).
+    kv_cache_dtype: str = ""
     #: multi-token applies may CONTINUE an advanced cache: rope positions
     #: and cache slots offset by cache_index and attention runs against the
     #: full cache, so a long prompt can prefill in bounded-memory chunks
@@ -101,6 +108,10 @@ class GPTConfig:
         if self.attn_global_every < 0:
             raise ValueError(
                 f"attn_global_every={self.attn_global_every} must be >= 0")
+        if self.kv_cache_dtype not in ("", "int8"):
+            raise ValueError(
+                f"kv_cache_dtype={self.kv_cache_dtype!r} must be '' (store "
+                "at dtype) or 'int8'")
 
     def layer_window(self, layer: int) -> int:
         """Effective sliding window for layer ``layer`` (0-indexed): 0 when
@@ -150,6 +161,51 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _kv_quant(a: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization over the last (d_head) axis: returns
+    (int8 values, f32 scale with keepdims). Zero rows quantize to zeros
+    with the epsilon scale — dequant reproduces zero exactly."""
+    s = jnp.maximum(jnp.max(jnp.abs(a.astype(jnp.float32)), axis=-1,
+                            keepdims=True), 1e-6) / 127.0
+    q = jnp.clip(jnp.round(a.astype(jnp.float32) / s),
+                 -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def _cache_read(cfg, cvar, svar) -> jax.Array:
+    """Cache contents at compute dtype (dequantizing when int8). XLA can
+    fuse the dequant multiply into the consuming einsum; the capacity win
+    (half the resident bytes) holds regardless."""
+    if svar is None:
+        return cvar.value
+    return (cvar.value.astype(jnp.float32) * svar.value).astype(cfg.dtype)
+
+
+def _cache_put_at(cfg, cvar, svar, slots, a) -> None:
+    """Gather-indexed cache write (prefill paths) — ONE definition with
+    :func:`_cache_put_dyn` of how quantization happens, so the three
+    write sites cannot desynchronize."""
+    if svar is None:
+        cvar.value = cvar.value.at[:, :, slots, :].set(a.astype(cfg.dtype))
+    else:
+        q, s = _kv_quant(a)
+        cvar.value = cvar.value.at[:, :, slots, :].set(q)
+        svar.value = svar.value.at[:, :, slots, :].set(s)
+
+
+def _cache_put_dyn(cfg, cvar, svar, slot, a) -> None:
+    """Single-slot dynamic cache write (the decode step)."""
+    if svar is None:
+        cvar.value = jax.lax.dynamic_update_slice_in_dim(
+            cvar.value, a.astype(cfg.dtype), slot, axis=2)
+    else:
+        q, s = _kv_quant(a)
+        cvar.value = jax.lax.dynamic_update_slice_in_dim(
+            cvar.value, q, slot, axis=2)
+        svar.value = jax.lax.dynamic_update_slice_in_dim(
+            svar.value, s, slot, axis=2)
+
+
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
     mesh: Optional[Mesh]
@@ -184,13 +240,21 @@ class CausalSelfAttention(nn.Module):
         is_initialized = self.has_variable("cache", "cached_key")
         cache_len = (min(cfg.decode_len, self.window)
                      if self.window else cfg.decode_len)
+        quant = cfg.kv_cache_dtype == "int8"
+        store = jnp.int8 if quant else cfg.dtype
         ck = self.variable("cache", "cached_key", jnp.zeros,
-                           (b, kv_heads, cache_len, d_head), cfg.dtype)
+                           (b, kv_heads, cache_len, d_head), store)
         cv = self.variable("cache", "cached_value", jnp.zeros,
-                           (b, kv_heads, cache_len, d_head), cfg.dtype)
+                           (b, kv_heads, cache_len, d_head), store)
+        sk = sv = None
+        if quant:
+            sk = self.variable("cache", "key_scale", jnp.zeros,
+                               (b, kv_heads, cache_len, 1), jnp.float32)
+            sv = self.variable("cache", "value_scale", jnp.zeros,
+                               (b, kv_heads, cache_len, 1), jnp.float32)
         ci = self.variable("cache", "cache_index",
                            lambda: jnp.zeros((), jnp.int32))
-        return ck, cv, ci, cache_len, is_initialized
+        return ck, cv, sk, sv, ci, cache_len, is_initialized
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
@@ -222,11 +286,13 @@ class CausalSelfAttention(nn.Module):
             # cache_index, and attention runs against the FULL cache — chunk
             # i attends its own chunk's keys plus every pre-chunk position
             # still in its window, so consecutive chunk applies reproduce
-            # the one-shot prefill exactly (parity-tested on logits). Costs
+            # the one-shot prefill exactly (parity-tested on logits; with
+            # an int8 cache, pre-chunk keys read back dequantized, so
+            # "exactly" relaxes to quantization tolerance). Costs
             # [t, L+t] dense scores per layer instead of the flash kernel:
             # the bounded-memory trade chunking exists for.
             b = x.shape[0]
-            ck, cv, ci, cache_len, is_initialized = self._cache_vars(
+            ck, cv, sk, sv, ci, cache_len, is_initialized = self._cache_vars(
                 b, kv_heads, d_head)
             start = ci.value if is_initialized else jnp.int32(0)
             qpos = start + jnp.arange(t)
@@ -237,14 +303,13 @@ class CausalSelfAttention(nn.Module):
             # still inside earlier in-chunk queries' windows the moment the
             # rolling buffer wraps (any chunk >= 2 tokens) — the snapshot
             # keeps every key any query can legally see.
-            k_old, v_old = ck.value, cv.value
+            k_old = _cache_read(cfg, ck, sk)
+            v_old = _cache_read(cfg, cv, sv)
             if is_initialized:
                 keep = min(cache_len, t)
                 wslots = jnp.remainder(qpos[t - keep:], cache_len)
-                ck.value = ck.value.at[:, :, wslots, :].set(
-                    k[:, :, t - keep:, :].astype(cfg.dtype))
-                cv.value = cv.value.at[:, :, wslots, :].set(
-                    v[:, :, t - keep:, :].astype(cfg.dtype))
+                _cache_put_at(cfg, ck, sk, wslots, k[:, :, t - keep:, :])
+                _cache_put_at(cfg, cv, sv, wslots, v[:, :, t - keep:, :])
                 ci.value = start + t
             # cache slots decode at idx_old = start-1 (newest pre-chunk
             # position congruent to s; same formula as single-token decode).
@@ -286,7 +351,7 @@ class CausalSelfAttention(nn.Module):
             # KV-cache decode: one token in, attend against all cached
             # positions <= idx. Cache layout [B, H, L, D] matches training.
             b = x.shape[0]
-            ck, cv, ci, cache_len, is_initialized = self._cache_vars(
+            ck, cv, sk, sv, ci, cache_len, is_initialized = self._cache_vars(
                 b, kv_heads, d_head)
             idx = ci.value
             pos = idx[None]
@@ -294,10 +359,8 @@ class CausalSelfAttention(nn.Module):
             k = rope(k, pos, cfg.rope_theta)
             if is_initialized:
                 slot = jax.lax.rem(idx, jnp.int32(cache_len))
-                ck.value = jax.lax.dynamic_update_slice_in_dim(
-                    ck.value, k.astype(cfg.dtype), slot, axis=2)
-                cv.value = jax.lax.dynamic_update_slice_in_dim(
-                    cv.value, v.astype(cfg.dtype), slot, axis=2)
+                _cache_put_dyn(cfg, ck, sk, slot, k)
+                _cache_put_dyn(cfg, cv, sv, slot, v)
                 ci.value = idx + 1
             # slot s currently holds position p_s = idx - ((idx - s) mod L):
             # the newest position <= idx congruent to s. Valid iff p_s >= 0.
@@ -311,13 +374,15 @@ class CausalSelfAttention(nn.Module):
             # materializing expand_kv(cache) would re-read group x the cache
             # bytes per token per layer — the exact cost GQA removes. Query
             # head h = kv*group + g reads shared head kv.
+            keys = _cache_read(cfg, ck, sk)
+            vals = _cache_read(cfg, cv, sv)
             qg = q[:, :, 0, :].reshape(b, kv_heads, group, d_head)
-            s = jnp.einsum("bkgd,bkld->bkgl", qg, ck.value,
+            s = jnp.einsum("bkgd,bkld->bkgl", qg, keys,
                            preferred_element_type=jnp.float32)
             s = s * d_head ** -0.5 + bias[None, None, None, :]
             p = jax.nn.softmax(s, axis=-1)  # >=1 valid key: no dead rows
-            out = jnp.einsum("bkgl,bkld->bkgd", p.astype(cv.value.dtype),
-                             cv.value, preferred_element_type=jnp.float32)
+            out = jnp.einsum("bkgl,bkld->bkgd", p.astype(vals.dtype),
+                             vals, preferred_element_type=jnp.float32)
             out = out.astype(cfg.dtype).reshape(b, 1, cfg.d_model)
             return nn.Dense(cfg.d_model, dtype=cfg.dtype,
                             param_dtype=jnp.float32, name="attn_out")(out)
@@ -350,7 +415,7 @@ class CausalSelfAttention(nn.Module):
             # at their rolling slots (slot = pos % L, same layout the
             # single-token branch maintains) and cache_index advances by t.
             # K/V are still UNexpanded here — the cache holds kv_heads.
-            ck, cv, ci, cache_len, is_initialized = self._cache_vars(
+            ck, cv, sk, sv, ci, cache_len, is_initialized = self._cache_vars(
                 x.shape[0], kv_heads, d_head)
             # One-shot prefill only: rope used positions 0..t-1 and the
             # slot math below assumes the sequence starts at 0, so a
@@ -368,10 +433,8 @@ class CausalSelfAttention(nn.Module):
             if is_initialized:
                 keep = min(cache_len, t)
                 slots = jnp.remainder(jnp.arange(t - keep, t), cache_len)
-                ck.value = ck.value.at[:, :, slots, :].set(
-                    k[:, :, t - keep:, :].astype(cfg.dtype))
-                cv.value = cv.value.at[:, :, slots, :].set(
-                    v[:, :, t - keep:, :].astype(cfg.dtype))
+                _cache_put_at(cfg, ck, sk, slots, k[:, :, t - keep:, :])
+                _cache_put_at(cfg, cv, sv, slots, v[:, :, t - keep:, :])
                 ci.value = ci.value + t
         # expand AFTER rope (rope on kv_heads is cheaper); the repeat is a
         # transient — cache/params only ever hold kv_heads. The seq-sharded
@@ -602,7 +665,10 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
     prefill activation memory is O(chunk·(L+chunk)) instead of O(T_p²),
     the knob for prompts whose one-shot score matrix doesn't fit.
     Matches one-shot prefill logits exactly (parity-tested), including
-    rolling-window caches that wrap mid-prompt.
+    rolling-window caches that wrap mid-prompt — at full-precision cache
+    dtypes. With ``kv_cache_dtype="int8"`` chunked prefill reads
+    pre-chunk keys back DEQUANTIZED while one-shot attends raw K/V, so
+    parity is within quantization tolerance, not exact (tested).
 
     ``mesh``: shard the decode — the KV cache lands P('data','model')
     (batch over data shards, heads over TP shards; see

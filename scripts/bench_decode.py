@@ -47,6 +47,7 @@ def child():
     kv_heads = int(os.environ.get("DTF_DEC_KV", "0")) or None
     window = int(os.environ.get("DTF_DEC_WINDOW", "0"))
     prefill_chunk = int(os.environ.get("DTF_DEC_PREFILL_CHUNK", "0"))
+    kv_dtype = "int8" if os.environ.get("DTF_DEC_INT8") == "1" else ""
     if tiny:
         b, t_p, n_new = 2, 8, 8
         base = gpt.GPTConfig.tiny(dtype=jax.numpy.bfloat16)
@@ -55,7 +56,7 @@ def child():
         base = gpt.GPTConfig.gpt2_small()
     total = t_p + n_new
     cfg = dataclasses.replace(base, decode_len=total, kv_heads=kv_heads,
-                              attn_window=window)
+                              attn_window=window, kv_cache_dtype=kv_dtype)
     model = gpt.GPT(cfg, None)
     variables = model.init(jax.random.PRNGKey(0),
                            jax.numpy.zeros((b, 1), jax.numpy.int32))
@@ -91,13 +92,14 @@ def child():
     kvh = cfg.kv_heads_resolved
     cache_len = min(total, window) if window else total
     d_head = cfg.d_model // cfg.heads
-    cache_bytes = 2 * b * kvh * cache_len * d_head * 2 * cfg.layers  # K+V bf16
+    kv_bytes = 1 + 4.0 / d_head if kv_dtype == "int8" else 2  # + scale
+    cache_bytes = 2 * b * kvh * cache_len * d_head * kv_bytes * cfg.layers
     row = {
         "model": ("gpt_tiny" if tiny else "gpt2_small") + "_decode",
         "backend": jax.default_backend(),
         "batch": b, "prompt": t_p, "n_new": n_new,
         "kv_heads": kvh, "heads": cfg.heads, "window": window,
-        "prefill_chunk": prefill_chunk,
+        "prefill_chunk": prefill_chunk, "kv_cache_dtype": kv_dtype,
         "cache_mib": round(cache_bytes / 2**20, 2),
         "sec_total": round(t_total, 4),
         "prefill_s": round(t_prefill, 4),
@@ -154,6 +156,9 @@ def main():
         # serving knob's cost vs its one-shot row above
         {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "256",
          "DTF_DEC_PREFILL_CHUNK": "64"},
+        # int8 KV cache on the same shape: half the cache bytes; decode is
+        # HBM-bound, so tokens/sec should track the byte reduction
+        {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "256", "DTF_DEC_INT8": "1"},
     ]
 
     def on_result(row, job, rows, errors):

@@ -45,6 +45,10 @@ flags.DEFINE_integer("seed", 0, "sampling PRNG seed")
 flags.DEFINE_integer("eos_id", -1, "stop token: once a sequence emits it, "
                      "later positions are --pad_id (-1 = no stop token)")
 flags.DEFINE_integer("pad_id", 0, "pad token written after --eos_id")
+flags.DEFINE_string("kv_cache_dtype", "", "'' = cache at compute dtype; "
+                    "'int8' = symmetric per-slot quantization — half the "
+                    "cache bytes, multiplicative with --kv_heads and "
+                    "--attn_window")
 flags.DEFINE_integer("prefill_chunk", 0, "prefill the prompt in chunks of "
                      "this many tokens (bounded-memory long prompts; "
                      "0 = one-shot prefill)")
@@ -94,6 +98,7 @@ def main(argv):
     cfg = dataclasses.replace(base, kv_heads=FLAGS.kv_heads or None,
                               attn_window=FLAGS.attn_window,
                               attn_global_every=FLAGS.attn_global_every,
+                              kv_cache_dtype=FLAGS.kv_cache_dtype,
                               decode_len=total)
     model = gpt.GPT(cfg)
 
