@@ -126,8 +126,28 @@ class GPTConfig:
         return self.heads if self.kv_heads is None else self.kv_heads
 
     @staticmethod
+    def by_name(name: str) -> "GPTConfig":
+        """The ONE size registry — every CLI/bench size switch routes
+        here so adding a size is a single edit. Raises KeyError with the
+        valid names for a typo (callers convert to their UsageError)."""
+        sizes = {"small": GPTConfig.gpt2_small,
+                 "medium": GPTConfig.gpt2_medium,
+                 "tiny": GPTConfig.tiny}
+        if name not in sizes:
+            raise KeyError(
+                f"unknown GPT size {name!r}; pick one of {sorted(sizes)}")
+        return sizes[name]()
+
+    @staticmethod
     def gpt2_small() -> "GPTConfig":
         return GPTConfig()
+
+    @staticmethod
+    def gpt2_medium() -> "GPTConfig":
+        """GPT-2 medium (355M): the single-chip MFU sweet spot — wider
+        matmuls (d_model 1024, d_ff 4096) fill the MXU better than
+        small's 768/3072 while params+adam+ZeRO-1 still fit one v5e."""
+        return GPTConfig(d_model=1024, layers=24, heads=16, d_ff=4096)
 
     @staticmethod
     def tiny(**kw) -> "GPTConfig":

@@ -91,7 +91,8 @@ def child():
         seq = int(os.environ.get("DTF_LM_SEQ", "64" if tiny else "1024"))
         import dataclasses
 
-        cfg = gpt.GPTConfig.tiny() if tiny else gpt.GPTConfig.gpt2_small()
+        size = os.environ.get("DTF_LM_GPT_SIZE", "small")
+        cfg = gpt.GPTConfig.tiny() if tiny else gpt.GPTConfig.by_name(size)
         fbh = int(os.environ.get("DTF_LM_FLASH_BH", "0"))
         if fbh:  # flash head-fold knob (must divide heads; sweep-only)
             cfg = dataclasses.replace(cfg, flash_block_h=fbh)
@@ -112,6 +113,7 @@ def child():
             SyntheticData("gpt", batch, seed=0, seq_len=seq,
                           vocab_size=cfg.vocab_size).batch(0), mesh)
         row.update(batch=batch, seq=seq, attn="flash(auto)",
+                   gpt_size="tiny" if tiny else size,
                    n_params=int(_count_params(state.params)), zero1=True,
                    loss_chunk=lchunk, loss_chunk_tokens=tchunk,
                    loss_pallas=lpallas)
@@ -268,6 +270,11 @@ def main():
         jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
                   "DTF_LM_LOSS_PALLAS": "1"}
                  for b in (8, 16, 32)]
+        # GPT-2 medium (355M): wider matmuls fill the MXU better — the
+        # config most likely to clear the 60% MFU north star
+        jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_GPT_SIZE": "medium",
+                  "DTF_LM_BATCH": str(b), "DTF_LM_LOSS_CHUNK_T": c}
+                 for b, c in ((4, "0"), (8, "4096"))]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
     elif "--sweep-bert" in sys.argv:
         # config-4 MFU levers: chunked loss, masked-position gather

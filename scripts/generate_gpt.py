@@ -26,7 +26,8 @@ dflags.define_cluster_flags()
 dflags.define_mesh_flags()
 flags.DEFINE_string("logdir", "/tmp/dtf_tpu_logs", "training logdir whose "
                     "ckpt/ subdir holds the checkpoint to serve")
-flags.DEFINE_string("size", "small", "small (gpt2-124M) | tiny — must match "
+flags.DEFINE_string("size", "small", "small (gpt2-124M) | medium "
+                    "(gpt2-355M) | tiny — must match "
                     "the trained config")
 flags.DEFINE_integer("kv_heads", 0, "grouped-query attention heads; must "
                      "match the trained config (0 = plain MHA)")
@@ -87,14 +88,19 @@ def main(argv):
         mesh = make_mesh(MeshConfig(data=dp, model=tp),
                          devices=jax.devices()[:dp * tp])
 
-    base = (gpt.GPTConfig.gpt2_small() if FLAGS.size == "small"
-            else gpt.GPTConfig.tiny())
+    try:
+        base = gpt.GPTConfig.by_name(FLAGS.size)
+    except KeyError as e:
+        raise app.UsageError(f"--size: {e.args[0]}")
     prompt_ids = ([int(t) for t in FLAGS.prompt.split(",") if t.strip()]
                   or [1, 2, 3, 4])
     if max(prompt_ids) >= base.vocab_size or min(prompt_ids) < 0:
         raise app.UsageError(
             f"prompt ids must be in [0, {base.vocab_size})")
     total = len(prompt_ids) + FLAGS.n_new
+    if FLAGS.kv_cache_dtype not in ("", "int8"):
+        raise app.UsageError(
+            f"--kv_cache_dtype={FLAGS.kv_cache_dtype!r}: '' or 'int8'")
     cfg = dataclasses.replace(base, kv_heads=FLAGS.kv_heads or None,
                               attn_window=FLAGS.attn_window,
                               attn_global_every=FLAGS.attn_global_every,

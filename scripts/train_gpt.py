@@ -25,7 +25,8 @@ dflags.define_mesh_flags()
 dflags.define_train_flags(batch_size=32, learning_rate=3e-4, train_steps=200,
                           lr_schedule="cosine")
 flags.DEFINE_integer("seq_len", 512, "sequence length")
-flags.DEFINE_string("size", "small", "small (gpt2-124M) | tiny")
+flags.DEFINE_string("size", "small", "small (gpt2-124M) | medium "
+                    "(gpt2-355M) | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
 flags.DEFINE_integer("moe_every", 0, "every k-th block uses Switch-MoE "
                      "(0 = dense)")
@@ -97,8 +98,10 @@ def main(argv):
     mesh, info = setup(FLAGS)
     sp = mesh.shape.get("seq", 1) > 1
 
-    base = (gpt.GPTConfig.gpt2_small() if FLAGS.size == "small"
-            else gpt.GPTConfig.tiny())
+    try:
+        base = gpt.GPTConfig.by_name(FLAGS.size)
+    except KeyError as e:
+        raise app.UsageError(f"--size: {e.args[0]}")
     import dataclasses
 
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,

@@ -792,8 +792,9 @@ def test_int8_kv_cache_generate_windowed_and_chunked_prefill():
     chunked = gpt.generate(model, variables["params"], prompt, 10,
                            prefill_chunk=5)
     assert chunked.shape == (2, 22)
-    # tokens may differ near decision boundaries; the bulk must agree
-    agree = (np.asarray(chunked) == np.asarray(out)).mean()
+    # tokens may differ near decision boundaries; the bulk of the
+    # GENERATED tokens must agree (the prompt matches by construction)
+    agree = (np.asarray(chunked[:, 12:]) == np.asarray(out[:, 12:])).mean()
     assert agree > 0.8, f"chunked-vs-oneshot agreement {agree}"
 
 
