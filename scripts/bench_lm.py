@@ -252,29 +252,49 @@ def main():
         # what makes batch >= 32 fit (full [B,T,50k] f32 logits + their
         # cotangent would exceed HBM). Results land in a separate
         # artifact; the best combo becomes the BENCH_LM default.
-        jobs = [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
-                 "DTF_LM_LOSS_CHUNK": c}
-                for b, c in ((8, "0"), (16, "0"), (8, "8192"), (16, "8192"),
-                             (32, "8192"), (64, "8192"))]
-        # (16, "0") added after the first on-chip sweep: chunking cost ~9
-        # MFU points at batch 8 (58.0% -> 48.9%), so the open question is
-        # whether unchunked batch 16 fits HBM — logits+cotangent ~6.6 GB —
-        # and beats 58%.
-        # Token-chunked rows (round 5): the chunking axis that keeps the
-        # per-step matmul full-vocab; expected between the monolithic and
-        # vocab-chunked points at the same bounded memory.
-        jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
-                  "DTF_LM_LOSS_CHUNK_T": "4096"}
-                 for b in (8, 16, 32)]
-        # Pallas fused head+CE rows: logits never leave VMEM
-        jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_BATCH": str(b),
-                  "DTF_LM_LOSS_PALLAS": "1"}
-                 for b in (8, 16, 32)]
-        # GPT-2 medium (355M): wider matmuls fill the MXU better — the
-        # config most likely to clear the 60% MFU north star
-        jobs += [{"DTF_LM_WHICH": "gpt", "DTF_LM_GPT_SIZE": "medium",
-                  "DTF_LM_BATCH": str(b), "DTF_LM_LOSS_CHUNK_T": c}
-                 for b, c in ((4, "0"), (8, "4096"))]
+        # Ordered by information value: a window that dies mid-sweep (both
+        # round-5 windows did die) should have already banked the rows
+        # that answer open questions. First the round-4 sweep's open
+        # questions + the new levers' flagship points, then the medium
+        # config, then the completion rows.
+        G = "gpt"
+        jobs = [
+            # same-window control (58.0% banked on 512x512-block flash;
+            # this re-measures it on the 512x1024 default)
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "8"},
+            # does unchunked batch 16 fit HBM (~6.6 GB logits+cotangent)
+            # and beat 58%? (chunking cost ~9 points at batch 8)
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "16"},
+            # the two new fused losses at the flagship point
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "8",
+             "DTF_LM_LOSS_PALLAS": "1"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "8",
+             "DTF_LM_LOSS_CHUNK_T": "4096"},
+            # GPT-2 medium (355M): wider matmuls fill the MXU better —
+            # the config most likely to clear the 60% MFU north star
+            {"DTF_LM_WHICH": G, "DTF_LM_GPT_SIZE": "medium",
+             "DTF_LM_BATCH": "4"},
+            {"DTF_LM_WHICH": G, "DTF_LM_GPT_SIZE": "medium",
+             "DTF_LM_BATCH": "8", "DTF_LM_LOSS_CHUNK_T": "4096"},
+            # batch scaling under each bounded-memory loss
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "16",
+             "DTF_LM_LOSS_PALLAS": "1"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "16",
+             "DTF_LM_LOSS_CHUNK_T": "4096"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "32",
+             "DTF_LM_LOSS_PALLAS": "1"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "32",
+             "DTF_LM_LOSS_CHUNK_T": "4096"},
+            # vocab-chunked completion rows (the round-4 plan's ladder)
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "8",
+             "DTF_LM_LOSS_CHUNK": "8192"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "16",
+             "DTF_LM_LOSS_CHUNK": "8192"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "32",
+             "DTF_LM_LOSS_CHUNK": "8192"},
+            {"DTF_LM_WHICH": G, "DTF_LM_BATCH": "64",
+             "DTF_LM_LOSS_CHUNK": "8192"},
+        ]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP.json")
     elif "--sweep-bert" in sys.argv:
         # config-4 MFU levers: chunked loss, masked-position gather
