@@ -801,3 +801,11 @@ def test_int8_kv_cache_generate_windowed_and_chunked_prefill():
 def test_kv_cache_dtype_validated():
     with pytest.raises(ValueError, match="kv_cache_dtype"):
         gpt.GPTConfig.tiny(kv_cache_dtype="fp8")
+
+
+def test_gpt_size_registry():
+    assert gpt.GPTConfig.by_name("medium").d_model == 1024
+    assert gpt.GPTConfig.by_name("small").d_model == 768
+    assert gpt.GPTConfig.by_name("tiny").layers == 2
+    with pytest.raises(KeyError, match="medium"):
+        gpt.GPTConfig.by_name("gpt5")
