@@ -157,6 +157,7 @@ def main():
                   "vs_baseline": 0,
                   "error": ("backend unavailable (probe failed): "
                             + "; ".join(probe_errors))[:2000]}
+        _finalize(result)
         print(json.dumps(result))
         return 0
     # probe warmed the plugin; ONE measurement attempt in the time left
@@ -169,12 +170,25 @@ def main():
                   "vs_baseline": 0,
                   "error": (f"probe OK (backend={backend}) but measurement "
                             "failed: " + "; ".join(errors))[:2000]}
-    if "error" not in result:
-        # a failed headline run must not carry stale artifact numbers that
-        # read as this run's measurements
-        _attach_companion_metrics(result)
+    _finalize(result)
     print(json.dumps(result))
     return 0  # structured error on stdout IS the contract; rc 0 so it lands
+
+
+def _finalize(result: dict) -> None:
+    """Attach companion numbers — inline on a live run, or under an
+    explicit ``banked_from_committed_artifacts`` key on a failed one.
+    A failed headline must not present stale artifact numbers as THIS
+    run's measurements, but the scoreboard line should still point at
+    the committed on-chip evidence (measured in an earlier tunnel
+    window; provenance in PERF.md §0b)."""
+    if "error" not in result:
+        _attach_companion_metrics(result)
+        return
+    banked: dict = {}
+    _attach_companion_metrics(banked)
+    if banked:
+        result["banked_from_committed_artifacts"] = banked
 
 
 def _attach_companion_metrics(result: dict) -> None:
@@ -216,7 +230,10 @@ def _attach_companion_metrics(result: dict) -> None:
             result["flash_vs_dense_fwd_8k"] = row["fwd_speedup"]
     for row in rows_of("BENCH_LM.json", "decode", "rows"):
         if (row.get("backend") == "tpu"
-                and row.get("decode_tokens_per_sec")):
+                and row.get("decode_tokens_per_sec")
+                # dispatch-latency junk guard (the axon block_until_ready
+                # defect, PERF.md §0b): a real per-token step is >10 µs
+                and row.get("ms_per_step", 0) > 0.01):
             tag = ("gqa" if row.get("kv_heads", 0) < row.get("heads", 0)
                    else "mha")
             if row.get("window"):
