@@ -750,25 +750,7 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
             nxt = jnp.argmax(nxt_logits, -1)
         return nxt.astype(jnp.int32)
 
-    # PREFILL: the whole prompt in one parallel causal forward that also
-    # writes the KV cache (see CausalSelfAttention's prefill branch) —
-    # t_p MXU-shaped steps collapse into one, vs the old token-by-token
-    # teacher-forced loop. With prefill_chunk, the same work runs as a
-    # static Python loop of cache-continuing applies (bounded memory).
-    if prefill_chunk > 0:
-        cmodel = GPT(dataclasses.replace(cfg, chunked_prefill=True),
-                     model.mesh)
-        cache, logits = cache0, None
-        for s0 in range(0, t_p, prefill_chunk):
-            logits, mut = cmodel.apply(
-                {"params": params, "cache": cache},
-                prompt[:, s0:s0 + prefill_chunk],
-                deterministic=True, mutable=["cache"])
-            cache = mut["cache"]
-    else:
-        logits, mut = model.apply({"params": params, "cache": cache0},
-                                  prompt, deterministic=True,
-                                  mutable=["cache"])
+    logits, cache = _prefill(model, params, cache0, prompt, prefill_chunk)
     rng, sub = jax.random.split(rng)
     tok0 = pick(logits[:, -1], sub)
     # EOS semantics: a sequence that has EMITTED eos_id keeps stepping (the
@@ -789,10 +771,161 @@ def generate(model: GPT, params, prompt: jax.Array, n_new: int,
         return (mut["cache"], nxt, done, rng), nxt
 
     (_, _, _, _), toks = jax.lax.scan(
-        body, (mut["cache"], tok0, done0, rng), None, length=n_new - 1)
+        body, (cache, tok0, done0, rng), None, length=n_new - 1)
     out = jnp.concatenate(
         [prompt, tok0[:, None], toks.T.astype(jnp.int32)], axis=1)
     return out
+
+
+def _prefill(model: GPT, params, cache0, prompt, prefill_chunk: int):
+    """The shared prompt prefill: one parallel causal forward that writes
+    the KV cache (t_p MXU-shaped steps collapse into one), or — with
+    ``prefill_chunk`` — a static Python loop of cache-continuing applies
+    at O(chunk·(L+chunk)) peak memory. Returns (logits, cache)."""
+    cfg = model.cfg
+    t_p = prompt.shape[1]
+    if prefill_chunk > 0:
+        cmodel = GPT(dataclasses.replace(cfg, chunked_prefill=True),
+                     model.mesh)
+        cache, logits = cache0, None
+        for s0 in range(0, t_p, prefill_chunk):
+            logits, mut = cmodel.apply(
+                {"params": params, "cache": cache},
+                prompt[:, s0:s0 + prefill_chunk],
+                deterministic=True, mutable=["cache"])
+            cache = mut["cache"]
+        return logits, cache
+    logits, mut = model.apply({"params": params, "cache": cache0},
+                              prompt, deterministic=True,
+                              mutable=["cache"])
+    return logits, mut["cache"]
+
+
+def generate_beam(model: GPT, params, prompt: jax.Array, n_new: int, *,
+                  num_beams: int = 4,
+                  eos_id: Optional[int] = None, pad_id: int = 0,
+                  length_penalty: float = 0.0,
+                  prefill_chunk: int = 0) -> jax.Array:
+    """Beam-search decode: the deterministic search the sampling family
+    (:func:`generate`) doesn't cover. [B, T_p] -> [B, T_p + n_new].
+
+    Standard fixed-width beam search in one ``lax.scan`` (static shapes):
+    the cache runs at batch B*k; every step expands k beams x V tokens,
+    keeps the global top-k per batch row, and REORDERS the KV cache along
+    the batch axis to follow the surviving beams (the per-step gather is
+    beam search's inherent cost). Finished beams (``eos_id``) are frozen:
+    their only continuation is ``pad_id`` at zero added log-prob, so
+    their score stays comparable while the scan stays fixed-length.
+    ``length_penalty`` alpha rescores finals by ``score / len**alpha``
+    (0 = pure sum-logprob; GNMT-style normalization at 1.0). The emitted
+    (parent, token) lattice is backtraced after the scan — O(n) memory,
+    no in-scan sequence buffers.
+
+    Composes with ``prefill_chunk`` (shared :func:`_prefill`) and any
+    ``model.cfg`` cache variant (GQA / rolling window / int8 — the
+    reorder walks whatever leaves the cache collection has). Sharded
+    (mesh) decode is not wired for beams; shard the batch outside.
+    """
+    cfg = model.cfg
+    b, t_p = prompt.shape
+    k = num_beams
+    if k < 1:
+        raise ValueError(f"num_beams={k} must be >= 1")
+    if n_new < 1:
+        raise ValueError(f"n_new={n_new} must be >= 1")
+    if cfg.decode_len < t_p + n_new:
+        raise ValueError(
+            f"decode_len={cfg.decode_len} < prompt+new={t_p + n_new}")
+
+    # Prefill ONCE at batch B (k identical beams would pay k-fold
+    # redundant prompt compute and O(T_p^2) activation memory), then
+    # clone the cache k-fold into the beam-expanded layout: rows
+    # [b*k + i] are batch b's beams.
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((b, 1), jnp.int32)))
+    cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                          shapes["cache"])
+    logits, cache = _prefill(model, params, cache0, prompt, prefill_chunk)
+    cache = jax.tree.map(
+        lambda leaf: (jnp.repeat(leaf, k, axis=0)
+                      if getattr(leaf, "ndim", 0) >= 1
+                      and leaf.shape[0] == b else leaf), cache)
+    logits = jnp.repeat(logits[:, -1:], k, axis=0)           # [B*k, 1, V]
+
+    def reorder(cache, parent):
+        rows = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+        return jax.tree.map(
+            lambda leaf: (leaf[rows]
+                          if getattr(leaf, "ndim", 0) >= 1
+                          and leaf.shape[0] == b * k else leaf), cache)
+
+    def expand(scores, logprobs, done):
+        """(scores [B,k], logprobs [B,k,V], done [B,k]) -> top-k beams:
+        (new scores, parent [B,k], token [B,k], new done)."""
+        if eos_id is not None:
+            # frozen beams continue ONLY as pad at zero added log-prob
+            frozen = jnp.full(logprobs.shape[-1:], -jnp.inf
+                              ).at[pad_id].set(0.0)
+            logprobs = jnp.where(done[:, :, None], frozen[None, None],
+                                 logprobs)
+        total = scores[:, :, None] + logprobs                # [B,k,V]
+        v = total.shape[-1]
+        flat = total.reshape(b, k * v)
+        new_scores, idx = jax.lax.top_k(flat, k)             # [B,k]
+        parent = idx // v
+        token = (idx % v).astype(jnp.int32)
+        new_done = jnp.take_along_axis(done, parent, 1)
+        if eos_id is not None:
+            new_done = new_done | (token == eos_id)
+        return new_scores, parent, token, new_done
+
+    logprobs0 = jax.nn.log_softmax(
+        logits[:, -1].astype(jnp.float32).reshape(b, k, -1))
+    # (the repeat above makes every beam's row identical; the score mask
+    # below is what breaks the symmetry)
+    # beams 1..k-1 start at -inf so the first top-k comes from beam 0
+    # (all beams are identical clones until they diverge here)
+    scores0 = jnp.where(jnp.arange(k)[None, :] == 0, 0.0, -jnp.inf)
+    scores0 = jnp.broadcast_to(scores0, (b, k))
+    done0 = jnp.zeros((b, k), bool)
+    scores, parent0, tok0, done = expand(scores0, logprobs0, done0)
+    cache = reorder(cache, parent0)
+    lens0 = jnp.ones((b, k), jnp.float32)                    # tokens emitted
+
+    def body(carry, _):
+        cache, scores, tok, done, lens = carry
+        logits, mut = model.apply(
+            {"params": params, "cache": cache}, tok.reshape(b * k, 1),
+            deterministic=True, mutable=["cache"])
+        logprobs = jax.nn.log_softmax(
+            logits[:, 0].astype(jnp.float32).reshape(b, k, -1))
+        new_scores, parent, token, new_done = expand(scores, logprobs, done)
+        lens = jnp.take_along_axis(lens, parent, 1) + jnp.where(
+            jnp.take_along_axis(done, parent, 1), 0.0, 1.0)
+        cache = reorder(mut["cache"], parent)
+        return ((cache, new_scores, token, new_done, lens),
+                (parent, token))
+
+    (cache, scores, tok, done, lens), (parents, tokens) = jax.lax.scan(
+        body, (cache, scores, tok0, done, lens0), None, length=n_new - 1)
+    # prepend step 1 so the backtrace covers every emitted token
+    parents = jnp.concatenate([parent0[None], parents], axis=0)  # [S,B,k]
+    tokens = jnp.concatenate([tok0[None], tokens], axis=0)       # [S,B,k]
+
+    final = scores
+    if length_penalty:
+        final = scores / jnp.maximum(lens, 1.0) ** length_penalty
+    best = jnp.argmax(final, axis=1)                             # [B]
+
+    def back(idx, pt):
+        par, tk = pt                                             # [B,k]
+        t = jnp.take_along_axis(tk, idx[:, None], 1)[:, 0]
+        nidx = jnp.take_along_axis(par, idx[:, None], 1)[:, 0]
+        return nidx, t
+
+    _, toks = jax.lax.scan(back, best, (parents, tokens), reverse=True)
+    return jnp.concatenate([prompt, toks.T.astype(jnp.int32)], axis=1)
 
 
 def make_eval(model: GPT, *, loss_chunk: int = 0,

@@ -40,6 +40,10 @@ flags.DEFINE_string("prompt", "", "comma-separated token ids; empty = a "
 flags.DEFINE_integer("batch", 1, "decode batch size (prompt is broadcast)")
 flags.DEFINE_integer("n_new", 32, "tokens to generate")
 flags.DEFINE_float("temperature", 0.0, "0 = greedy, else sampling")
+flags.DEFINE_integer("num_beams", 0, "beam-search width (0/1 = off); "
+                     "deterministic, excludes the sampling flags")
+flags.DEFINE_float("length_penalty", 0.0, "beam rescoring alpha: "
+                   "score / len**alpha (0 = pure sum-logprob)")
 flags.DEFINE_integer("top_k", 0, "top-k filter (0 = off)")
 flags.DEFINE_float("top_p", 1.0, "nucleus filter (1.0 = off)")
 flags.DEFINE_integer("seed", 0, "sampling PRNG seed")
@@ -67,6 +71,11 @@ def main(argv):
     from dtf_tpu.core.sharding import shard_tree
     from dtf_tpu.models import gpt
 
+    if FLAGS.num_beams > 1 and (FLAGS.temperature > 0.0 or FLAGS.top_k
+                                or FLAGS.top_p < 1.0):
+        raise app.UsageError(
+            "--num_beams is a deterministic search; it excludes "
+            "--temperature/--top_k/--top_p")
     if FLAGS.temperature == 0.0 and (FLAGS.top_k or FLAGS.top_p < 1.0):
         raise app.UsageError(
             "--top_k/--top_p have no effect at --temperature=0 (greedy); "
@@ -123,13 +132,24 @@ def main(argv):
 
     prompt = jnp.broadcast_to(jnp.asarray(prompt_ids, jnp.int32)[None, :],
                               (FLAGS.batch, len(prompt_ids)))
-    out = gpt.generate(model, params, prompt, FLAGS.n_new,
-                       rng=jax.random.PRNGKey(FLAGS.seed),
-                       temperature=FLAGS.temperature,
-                       top_k=FLAGS.top_k, top_p=FLAGS.top_p,
-                       eos_id=FLAGS.eos_id if FLAGS.eos_id >= 0 else None,
-                       pad_id=FLAGS.pad_id,
-                       prefill_chunk=FLAGS.prefill_chunk, mesh=mesh)
+    if FLAGS.num_beams > 1:
+        if mesh is not None:
+            raise app.UsageError("--num_beams does not compose with a "
+                                 "sharded decode mesh; shard the batch "
+                                 "outside instead")
+        out = gpt.generate_beam(
+            model, params, prompt, FLAGS.n_new, num_beams=FLAGS.num_beams,
+            eos_id=FLAGS.eos_id if FLAGS.eos_id >= 0 else None,
+            pad_id=FLAGS.pad_id, length_penalty=FLAGS.length_penalty,
+            prefill_chunk=FLAGS.prefill_chunk)
+    else:
+        out = gpt.generate(model, params, prompt, FLAGS.n_new,
+                           rng=jax.random.PRNGKey(FLAGS.seed),
+                           temperature=FLAGS.temperature,
+                           top_k=FLAGS.top_k, top_p=FLAGS.top_p,
+                           eos_id=FLAGS.eos_id if FLAGS.eos_id >= 0 else None,
+                           pad_id=FLAGS.pad_id,
+                           prefill_chunk=FLAGS.prefill_chunk, mesh=mesh)
     for row in np.asarray(out):
         print(",".join(str(int(t)) for t in row))
 
