@@ -22,7 +22,6 @@ import re
 from typing import Any, Sequence
 
 import jax
-import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -23,7 +23,6 @@ are all inside XLA's partitioned program, riding ICI instead of gRPC.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Any, Callable, Mapping, Sequence
 
 import jax

@@ -17,7 +17,7 @@ stream and ``depth`` bounds how many batches are in flight.
 from __future__ import annotations
 
 import collections
-from typing import Callable, Iterable, Iterator, Optional
+from typing import Callable, Iterable, Iterator
 
 Batch = object
 

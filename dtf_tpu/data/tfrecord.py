@@ -27,7 +27,7 @@ from __future__ import annotations
 import ctypes
 import glob as glob_mod
 import struct
-from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Union
+from typing import Dict, Iterable, Iterator, List, Optional, Union
 
 import numpy as np
 

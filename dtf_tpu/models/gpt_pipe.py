@@ -25,7 +25,7 @@ scaling beyond one chip's HBM.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,6 @@ An MLP variant is included for a non-trivial-capacity smoke model.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 import optax
 from flax import linen as nn

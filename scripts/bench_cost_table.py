@@ -61,7 +61,6 @@ def child():
     sys.path.insert(0, ROOT)
     import jax
     import jax.numpy as jnp
-    import numpy as np
     import optax
     from flax import linen as nn
 

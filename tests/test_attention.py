@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from dtf_tpu.ops import attention as att
 from dtf_tpu.ops.losses import softmax_cross_entropy

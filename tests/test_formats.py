@@ -226,7 +226,6 @@ def test_criteo_crlf_equals_lf(tmp_path):
 
 
 def test_criteo_readonly_source_dir_falls_back(tmp_path, monkeypatch):
-    import stat
     src_dir = tmp_path / "ro"
     src_dir.mkdir()
     p = src_dir / "train.txt"

@@ -2,7 +2,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from dtf_tpu.core import train as tr
 from dtf_tpu.core.comms import shard_batch
