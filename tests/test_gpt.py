@@ -878,3 +878,24 @@ def test_beam_eos_freezes_and_pads():
     assert eos in row, row
     after = row[list(row).index(eos) + 1:]
     assert (after == 0).all(), row
+
+
+def test_beam_composes_with_int8_rolling_cache():
+    """Beam search's cache reorder is dtype-agnostic: int8 + scales +
+    rolling window ride the per-step gather; decode is deterministic and
+    prompt-preserving."""
+    cfg = dataclasses.replace(
+        gpt.GPTConfig.tiny(dtype=jnp.float32, decode_len=20, kv_heads=2,
+                           attn_window=8),
+        kv_cache_dtype="int8")
+    model = gpt.GPT(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((2, 1), jnp.int32))
+    prompt = jnp.asarray(data_batch(n=2)["input_ids"][:, :6])
+    out = gpt.generate_beam(model, variables["params"], prompt, 10,
+                            num_beams=3)
+    assert out.shape == (2, 16)
+    np.testing.assert_array_equal(np.asarray(out[:, :6]), np.asarray(prompt))
+    out2 = gpt.generate_beam(model, variables["params"], prompt, 10,
+                             num_beams=3)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
