@@ -123,6 +123,26 @@ def test_gpt_train_then_generate_round_trip(tmp_path):
     assert any(ln.startswith("5,9,2,") for ln in gen_sampled.splitlines())
 
 
+def test_bench_lm_child_tiny_pallas_loss():
+    """CI-pin the DTF_LM_LOSS_PALLAS bench path (the fused head+CE row):
+    the kernel runs in interpret mode on the sim, so a wiring typo can't
+    surface for the first time mid-benchmark on the chip."""
+    import json
+
+    env = _env()
+    env.update(DTF_LM_WHICH="gpt", DTF_LM_TINY="1", DTF_LM_STEPS="2",
+               DTF_LM_LOSS_PALLAS="1")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_lm.py"),
+         "--child"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    row = next(json.loads(ln[len("BENCH_LM_ROW "):])
+               for ln in proc.stdout.splitlines()
+               if ln.startswith("BENCH_LM_ROW "))
+    assert row["loss_pallas"] is True and row["tokens_per_sec"] > 0
+
+
 @pytest.mark.parametrize("which", ["gpt", "bert", "widedeep"])
 def test_bench_lm_child_tiny_mode(which, tmp_path):
     """The LM bench children normally execute only on the TPU; tiny-mode
