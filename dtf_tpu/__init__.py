@@ -21,4 +21,5 @@ a TPU device mesh:
 
 __version__ = "0.1.0"
 
+from dtf_tpu import _jax_compat  # noqa: F401  (backfills jax.shard_map etc.)
 from dtf_tpu.core.mesh import MeshConfig, make_mesh, AXIS_DATA, AXIS_SEQ, AXIS_MODEL

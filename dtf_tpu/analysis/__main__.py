@@ -1,0 +1,142 @@
+"""``python -m dtf_tpu.analysis`` — run every static pass, print ONE JSON line.
+
+bench.py's resilience idiom: stdout's LAST line is always exactly one JSON
+object, whatever the backend situation.  The analyzer never needs a chip —
+but it does need the 8-device CPU sim, so if the calling environment is not
+already pinned there (e.g. PALLAS_AXON_POOL_IPS routes to the real TPU,
+where an import can hang on a dead tunnel) it re-execs itself into a
+scrubbed child exactly like ``__graft_entry__.dryrun_multichip``.
+
+    python -m dtf_tpu.analysis                       # all configs, all passes
+    python -m dtf_tpu.analysis --configs=bert,gpt    # subset
+    python -m dtf_tpu.analysis --passes=specs,jaxpr  # skip the compile pass
+    python -m dtf_tpu.analysis --write-golden        # regenerate the fence
+
+Exit status: 0 = no error findings, 1 = findings, 2 = analyzer crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+N_DEVICES = 8
+
+
+def _reexec_if_needed(argv: list[str]) -> None:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, root)
+    from _dtf_env import cpu_sim_env, is_cpu_sim
+
+    if is_cpu_sim(os.environ, N_DEVICES):
+        return
+    if os.environ.get("_DTF_TPU_ANALYSIS_REEXEC") == "1":
+        return
+    import subprocess
+
+    env = cpu_sim_env(N_DEVICES, os.environ)
+    env["_DTF_TPU_ANALYSIS_REEXEC"] = "1"
+    env.setdefault("PYTHONPATH", root)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis"] + argv,
+        env=env, cwd=root, timeout=1800)
+    sys.exit(proc.returncode)
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        _reexec_if_needed(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — the JSON-last-line contract
+        # (child timeout, missing _dtf_env, ...) must hold even when the
+        # bootstrap itself dies — exactly the TPU-pointed environments the
+        # re-exec exists to protect.
+        print(json.dumps({"ok": False,
+                          "error": f"bootstrap: {type(e).__name__}: "
+                                   f"{e}"[:500]}))
+        return 2
+
+    parser = argparse.ArgumentParser(prog="python -m dtf_tpu.analysis")
+    parser.add_argument("--configs", default="",
+                        help="comma-separated registry names (default all)")
+    parser.add_argument("--passes", default="specs,jaxpr,hlo",
+                        help="comma-separated passes to run")
+    parser.add_argument("--write-golden", action="store_true",
+                        help="regenerate STATIC_ANALYSIS.json comms budgets")
+    parser.add_argument("--golden", default="",
+                        help="override golden path")
+    args = parser.parse_args(argv)
+
+    from dtf_tpu.analysis import configs as cfgs
+    from dtf_tpu.analysis import hlo as hlo_pass
+    from dtf_tpu.analysis import runner
+    from dtf_tpu.analysis.findings import severity_counts
+
+    names = [n for n in args.configs.split(",") if n]
+    for n in names:
+        if n not in cfgs.BY_NAME:
+            print(json.dumps({"ok": False,
+                              "error": f"unknown config {n!r}; have "
+                                       f"{sorted(cfgs.BY_NAME)}"}))
+            return 2
+    passes = [p for p in args.passes.split(",") if p]
+    bad = [p for p in passes if p not in ("specs", "jaxpr", "hlo")]
+    if bad:
+        # a typo'd pass must not silently disable the fence (exit 0, ran
+        # nothing) — same contract as unknown --configs
+        print(json.dumps({"ok": False,
+                          "error": f"unknown passes {bad}; valid: "
+                                   f"specs,jaxpr,hlo"}))
+        return 2
+    golden_file = args.golden or runner.golden_path()
+
+    try:
+        if args.write_golden:
+            budgets = {
+                c.name: runner.compile_budget(c)
+                for c in (cfgs.REGISTRY if not names
+                          else [cfgs.BY_NAME[n] for n in names])}
+            import jax
+
+            existing = (hlo_pass.load_golden(golden_file).get("budgets", {})
+                        if os.path.exists(golden_file) else {})
+            existing.update(budgets)
+            hlo_pass.save_golden(
+                golden_file, existing,
+                meta={"jax": jax.__version__, "devices": N_DEVICES,
+                      "regen": "python -m dtf_tpu.analysis --write-golden",
+                      "note": "comms budget of each config's tiny AOT-"
+                              "compiled train step on the 8-device CPU sim"})
+            print(json.dumps({"ok": True, "wrote": golden_file,
+                              "configs": sorted(budgets)}))
+            return 0
+
+        golden = (hlo_pass.load_golden(golden_file)
+                  if os.path.exists(golden_file) else {"budgets": {}})
+        findings = runner.analyze(names or None, passes, golden=golden)
+    except Exception as e:  # noqa: BLE001 — last line must still be JSON
+        print(json.dumps({"ok": False,
+                          "error": f"{type(e).__name__}: {e}"[:500]}))
+        return 2
+
+    counts = severity_counts(findings)
+    out = {
+        "ok": counts["error"] == 0,
+        "configs": names or sorted(cfgs.BY_NAME),
+        "passes": passes,
+        "findings": counts["error"] + counts["warning"],
+        "severities": counts,
+        "details": [f.to_json() for f in findings
+                    if f.severity != "info"][:50],
+    }
+    print(json.dumps(out))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

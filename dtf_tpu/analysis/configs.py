@@ -1,0 +1,324 @@
+"""The analyzable-config registry: every shipping parallelism configuration,
+described once, for all three analyzer passes.
+
+Each entry carries two views:
+
+- **spec view** (real scale): the production model's abstract param tree +
+  its rulebook, built with ``jax.eval_shape`` — free, so the rule lints run
+  against the REAL dims (a 768-hidden BERT, the 50304-vocab GPT head), where
+  divisibility actually matters.
+- **step view** (tiny scale): the same train-step construction the launcher
+  performs, on the ``tiny`` model config — compiled AOT on the 8-device CPU
+  sim for the comms-budget fence and traced for the jaxpr lints.  Tiny
+  shapes keep compile cost test-tier friendly; the collective STRUCTURE
+  (which collectives, on which paths) is what the fence pins, and that is a
+  property of the sharding code, not the layer count.
+
+``replicated_ok`` / ``allow_dead`` encode each config's *intentional*
+deviations (pipeline embed/head replicated by design; the MoE expert rule
+dead on dense GPT) so the analyzer can hold everything else to zero
+findings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Sequence
+
+import jax
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dtf_tpu.core import train as tr
+from dtf_tpu.core.comms import batch_shardings_for
+from dtf_tpu.core.mesh import MeshConfig, make_mesh
+from dtf_tpu.data.synthetic import SyntheticData
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecView:
+    params: PyTree                    # abstract (ShapeDtypeStruct) tree
+    rules: Sequence[tuple]            # the production rulebook
+    zero1: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class StepView:
+    step: Callable                    # jitted train step (AOT-lowerable)
+    state: PyTree                     # abstract TrainState
+    batch: PyTree                     # abstract batch
+
+
+@dataclasses.dataclass(frozen=True)
+class AnalysisConfig:
+    name: str
+    mesh_config: MeshConfig
+    spec_view: Callable[[Mesh], SpecView]
+    step_view: Callable[[Mesh], StepView]
+    #: rule patterns allowed to match nothing in THIS config (e.g. the MoE
+    #: expert rule on a dense GPT — the rulebook is shared).
+    allow_dead: tuple[str, ...] = ()
+    #: leaf-path regexes intentionally replicated despite their size.
+    replicated_ok: tuple[str, ...] = ()
+
+    def mesh(self, devices=None) -> Mesh:
+        return make_mesh(self.mesh_config, devices=devices)
+
+
+def _abstract_batch(kind: str, batch: int, **kw) -> PyTree:
+    example = SyntheticData(kind, batch, **kw).batch(0)
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype),
+        example)
+
+
+def _rng():
+    return jax.random.PRNGKey(0)
+
+
+# --------------------------------------------------------------------------
+# Per-workload builders.  Each step_view mirrors its launcher's train-step
+# construction (scripts/*.py) — same loss, optimizer family, rules, ZeRO-1
+# and batch placement — at tiny scale.
+# --------------------------------------------------------------------------
+
+def _mnist_spec(mesh):
+    from dtf_tpu.models import mnist
+
+    model = mnist.make_model("softmax")
+    params = jax.eval_shape(mnist.make_init(model), _rng())["params"]
+    return SpecView(params, rules=())
+
+
+def _mnist_step(mesh):
+    from dtf_tpu.models import mnist
+
+    model = mnist.make_model("softmax")
+    tx = optax.sgd(0.01)
+    state, shardings = tr.abstract_train_state(
+        mnist.make_init(model), tx, _rng(), mesh)
+    step = tr.make_train_step(mnist.make_loss(model), tx, mesh, shardings)
+    return StepView(step, state, _abstract_batch("mnist", 32))
+
+
+def _resnet_spec(variant):
+    def build(mesh):
+        from dtf_tpu.models import resnet
+
+        model = (resnet.resnet20() if variant == "cifar"
+                 else resnet.resnet50())
+        shape = (32, 32, 3) if variant == "cifar" else (224, 224, 3)
+        params = jax.eval_shape(
+            resnet.make_init(model, shape), _rng())["params"]
+        return SpecView(params, rules=())
+
+    return build
+
+
+def _resnet_step(variant, batch):
+    def build(mesh):
+        from dtf_tpu.models import resnet
+
+        model = (resnet.resnet20() if variant == "cifar"
+                 else resnet.resnet50())
+        shape = (32, 32, 3) if variant == "cifar" else (224, 224, 3)
+        tx = optax.sgd(0.1, momentum=0.9, nesterov=True)
+        state, shardings = tr.abstract_train_state(
+            resnet.make_init(model, shape), tx, _rng(), mesh)
+        step = tr.make_train_step(
+            resnet.make_loss(model, weight_decay=1e-4), tx, mesh, shardings)
+        return StepView(step, state, _abstract_batch(variant, batch))
+
+    return build
+
+
+def _bert_spec(mesh):
+    from dtf_tpu.models import bert
+
+    cfg = bert.BertConfig.base()
+    _, init_fn = bert.make_init(cfg, mesh, seq_len=128)
+    params = jax.eval_shape(init_fn, _rng())["params"]
+    return SpecView(params, rules=bert.tp_rules)
+
+
+def _bert_step(mesh):
+    from dtf_tpu.models import bert
+
+    cfg = bert.BertConfig.tiny()
+    model, init_fn = bert.make_init(cfg, mesh, seq_len=32)
+    tx = optax.adamw(1e-4, weight_decay=0.01)
+    state, shardings = tr.abstract_train_state(
+        init_fn, tx, _rng(), mesh, param_rules=bert.tp_rules)
+    batch = _abstract_batch("bert", 16, seq_len=32, vocab_size=128)
+    batch_sh = batch_shardings_for(batch, mesh, P("data", "seq"))
+    step = tr.make_train_step(
+        bert.make_loss(model), tx, mesh, shardings, grad_accum=2,
+        batch_shardings=batch_sh)
+    return StepView(step, state, batch)
+
+
+def _widedeep_spec(mesh):
+    from dtf_tpu.models import widedeep
+
+    model = widedeep.WideDeep()
+    params = jax.eval_shape(widedeep.make_init(model), _rng())["params"]
+    return SpecView(params, rules=widedeep.rules)
+
+
+def _widedeep_step(mesh):
+    from dtf_tpu.models import widedeep
+
+    model = widedeep.WideDeep()
+    tx = optax.adam(1e-3)
+    state, shardings = tr.abstract_train_state(
+        widedeep.make_init(model), tx, _rng(), mesh,
+        param_rules=widedeep.rules)
+    step = tr.make_train_step(widedeep.make_loss(model), tx, mesh,
+                              shardings)
+    return StepView(step, state, _abstract_batch("widedeep", 64))
+
+
+def _gpt_cfg(tiny: bool, **kw):
+    from dtf_tpu.models import gpt
+
+    return (gpt.GPTConfig.tiny(**kw) if tiny
+            else dataclasses.replace(gpt.GPTConfig.gpt2_small(), **kw))
+
+
+def _gpt_spec(**cfg_kw):
+    def build(mesh):
+        from dtf_tpu.models import gpt
+
+        _, init_fn = gpt.make_init(_gpt_cfg(False, **cfg_kw), mesh,
+                                   seq_len=128)
+        params = jax.eval_shape(init_fn, _rng())["params"]
+        return SpecView(params, rules=gpt.tp_rules)
+
+    return build
+
+
+def _gpt_step(**cfg_kw):
+    def build(mesh):
+        from dtf_tpu.models import gpt
+
+        cfg = _gpt_cfg(True, **cfg_kw)
+        model, init_fn = gpt.make_init(cfg, mesh, seq_len=32)
+        tx = optax.adamw(3e-4, weight_decay=0.1)
+        state, shardings = tr.abstract_train_state(
+            init_fn, tx, _rng(), mesh, param_rules=gpt.tp_rules)
+        batch = _abstract_batch("gpt", 8, seq_len=32, vocab_size=128)
+        sp = mesh.shape.get("seq", 1) > 1
+        kw = {}
+        if sp:
+            kw["batch_shardings"] = batch_shardings_for(
+                batch, mesh, P("data", "seq"))
+        step = tr.make_train_step(gpt.make_loss(model), tx, mesh,
+                                  shardings, **kw)
+        return StepView(step, state, batch)
+
+    return build
+
+
+def _gpt_pipe_spec(mesh):
+    from dtf_tpu.models import gpt, gpt_pipe
+
+    init_fn = gpt_pipe.make_pipe_init(gpt.GPTConfig.gpt2_small(), mesh,
+                                      seq_len=128)
+    params = jax.eval_shape(init_fn, _rng())["params"]
+    return SpecView(params, rules=gpt_pipe.pipe_rules())
+
+
+def _gpt_pipe_step(schedule):
+    def build(mesh):
+        from dtf_tpu.models import gpt, gpt_pipe
+
+        cfg = gpt.GPTConfig.tiny()
+        init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=32)
+        tx = optax.adamw(3e-4, weight_decay=0.1)
+        state, shardings = tr.abstract_train_state(
+            init_fn, tx, _rng(), mesh, param_rules=gpt_pipe.pipe_rules())
+        batch = _abstract_batch("gpt", 16, seq_len=32, vocab_size=128)
+        if schedule == "1f1b":
+            grads_fn = gpt_pipe.make_pipe_grads_1f1b(
+                cfg, mesh, n_microbatches=4)
+            step = tr.make_train_step_from_grads(grads_fn, tx, mesh,
+                                                 shardings)
+        else:
+            loss_fn = gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4)
+            step = tr.make_train_step(loss_fn, tx, mesh, shardings)
+        return StepView(step, state, batch)
+
+    return build
+
+
+def _gpt_pipe_tp_spec(mesh):
+    from dtf_tpu.models import gpt, gpt_pipe_tp
+
+    init_fn = gpt_pipe_tp.make_pipe_tp_init(gpt.GPTConfig.gpt2_small(),
+                                            mesh, seq_len=128)
+    params = jax.eval_shape(init_fn, _rng())["params"]
+    return SpecView(params, rules=gpt_pipe_tp.pipe_tp_rules())
+
+
+def _gpt_pipe_tp_step(mesh):
+    from dtf_tpu.models import gpt, gpt_pipe_tp
+
+    cfg = gpt.GPTConfig.tiny()
+    init_fn = gpt_pipe_tp.make_pipe_tp_init(cfg, mesh, seq_len=32)
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    state, shardings = tr.abstract_train_state(
+        init_fn, tx, _rng(), mesh,
+        param_rules=gpt_pipe_tp.pipe_tp_rules())
+    loss_fn = gpt_pipe_tp.make_pipe_tp_loss(cfg, mesh, n_microbatches=4)
+    step = tr.make_train_step(loss_fn, tx, mesh, shardings)
+    return StepView(step, state,
+                    _abstract_batch("gpt", 8, seq_len=32, vocab_size=128))
+
+
+#: the registry: five BASELINE workloads + the GPT flagship + pipelined
+#: variants + the MoE expert-parallel path (all-to-all coverage).
+REGISTRY: tuple[AnalysisConfig, ...] = (
+    AnalysisConfig("mnist", MeshConfig(data=8), _mnist_spec, _mnist_step),
+    AnalysisConfig("resnet_cifar", MeshConfig(data=8),
+                   _resnet_spec("cifar"), _resnet_step("cifar", 16)),
+    AnalysisConfig("resnet_imagenet", MeshConfig(data=8),
+                   _resnet_spec("imagenet"), _resnet_step("imagenet", 8)),
+    AnalysisConfig("bert", MeshConfig(data=2, seq=2, model=2),
+                   _bert_spec, _bert_step),
+    AnalysisConfig("widedeep", MeshConfig(data=4, model=2),
+                   _widedeep_spec, _widedeep_step),
+    AnalysisConfig("gpt", MeshConfig(data=2, seq=2, model=2),
+                   _gpt_spec(), _gpt_step(),
+                   # the shared GPT rulebook carries the MoE expert rule;
+                   # dense flagship has no expert params.
+                   allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_moe", MeshConfig(data=4, expert=2),
+                   _gpt_spec(moe_every=2), _gpt_step(moe_every=2)),
+    AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),
+                   _gpt_pipe_spec, _gpt_pipe_step("gpipe"),
+                   # embed/head ride ZeRO-1 over data, not the pipe axis
+                   # (gpt_pipe.pipe_rules docstring).
+                   replicated_ok=(r"^embed/", r"^head/")),
+    AnalysisConfig("gpt_pipe_1f1b", MeshConfig(data=4, pipe=2),
+                   _gpt_pipe_spec, _gpt_pipe_step("1f1b"),
+                   replicated_ok=(r"^embed/", r"^head/")),
+    AnalysisConfig("gpt_pipe_tp", MeshConfig(data=2, pipe=2, model=2),
+                   _gpt_pipe_tp_spec, _gpt_pipe_tp_step,
+                   replicated_ok=(r"^embed/", r"^head/")),
+)
+
+BY_NAME = {c.name: c for c in REGISTRY}
+
+#: every optimizer family ``cli/flags.py make_optimizer`` can emit — the
+#: ZeRO-1 spec lint runs the whole set against every config's params.
+OPTIMIZER_FAMILIES: dict[str, Callable[[], optax.GradientTransformation]] = {
+    "sgd": lambda: optax.sgd(0.01),
+    "momentum": lambda: optax.sgd(0.01, momentum=0.9, nesterov=True),
+    "adam": lambda: optax.adam(1e-3),
+    "adamw": lambda: optax.adamw(1e-3, weight_decay=1e-4),
+    "lamb": lambda: optax.lamb(1e-3, weight_decay=1e-4),
+    "adafactor": lambda: optax.adafactor(1e-3),
+}

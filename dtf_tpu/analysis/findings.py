@@ -1,0 +1,43 @@
+"""The one currency every analyzer pass trades in."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterable
+
+#: finding severities, in increasing order of "this ships broken".
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer hit.
+
+    ``severity='error'`` findings fail the CLI (and the fenced tests);
+    ``'info'`` records context (e.g. small leaves intentionally falling to
+    REPLICATED) without affecting the verdict.
+    """
+
+    config: str      # registry config name ("" = config-independent)
+    pass_name: str   # "specs" | "hlo" | "jaxpr" | "lint"
+    check: str       # kebab-case check id, e.g. "shadowed-rule"
+    severity: str    # one of SEVERITIES
+    detail: str      # human-readable, one line
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def errors(findings: Iterable[Finding]) -> list[Finding]:
+    return [f for f in findings if f.severity == "error"]
+
+
+def severity_counts(findings: Iterable[Finding]) -> dict[str, int]:
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] += 1
+    return counts

@@ -1,0 +1,123 @@
+"""Comms budget from AOT-compiled HLO — the regression fence for
+XLA-inserted collectives.
+
+The train step is lowered and compiled on the 8-device CPU sim
+(``step.lower(abstract_state, abstract_batch).compile()``); the optimized
+HLO text then names every collective GSPMD inserted — the all-reduce of
+the gradient mean, the reduce-scatter/all-gather pair of ZeRO-1, TP's
+activation all-reduces, the pipeline's collective-permutes.  That mix IS
+the framework's communication contract: an accidental resharding (a spec
+change that makes XLA all-gather a weight every step) shows up here as a
+count/byte diff against the committed golden (``STATIC_ANALYSIS.json``)
+long before a chip ever runs it.
+
+Parsing is textual on purpose: opcode spellings (``all-reduce``,
+``all-gather``, ``reduce-scatter``, ``collective-permute``,
+``all-to-all``, plus their async ``-start`` forms) are stable across XLA
+versions, and byte sizes fall out of the result shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Mapping
+
+from dtf_tpu.analysis.findings import Finding
+
+COLLECTIVE_OPS = ("all-reduce", "all-gather", "reduce-scatter",
+                  "collective-permute", "all-to-all")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+#: `lhs = <type> <opcode>(...)`; async `-start` counted, `-done` skipped
+#: (same transfer), fused/computation names can't match: the opcode slot
+#: sits right after the result type.
+_COLLECTIVE_RE = re.compile(
+    r"=\s+(?P<type>\([^=]*?\)|\S+)\s+"
+    r"(?P<op>" + "|".join(re.escape(o) for o in COLLECTIVE_OPS) + r")"
+    r"(?P<async>-start)?\(")
+
+_SHAPE_RE = re.compile(r"(?P<dtype>[a-z]+\d*)\[(?P<dims>[0-9,]*)\]")
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total bytes of every array shape in an HLO result type string."""
+    total = 0
+    for m in _SHAPE_RE.finditer(type_str):
+        nbytes = _DTYPE_BYTES.get(m.group("dtype"))
+        if nbytes is None:
+            continue   # token[] / opaque[] etc. carry no payload
+        n = 1
+        for d in m.group("dims").split(","):
+            if d:
+                n *= int(d)
+        total += n * nbytes
+    return total
+
+
+def collective_stats(hlo_text: str) -> dict:
+    """Per-opcode ``{count, bytes}`` plus totals, from optimized HLO text.
+
+    ``bytes`` is the per-device result payload of each collective (the
+    resharding volume a step moves over the interconnect, up to reduction
+    fan-in), summed over call sites.
+    """
+    stats = {op: {"count": 0, "bytes": 0} for op in COLLECTIVE_OPS}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        op = m.group("op")
+        stats[op]["count"] += 1
+        stats[op]["bytes"] += _shape_bytes(m.group("type"))
+    stats["total"] = {
+        "count": sum(stats[op]["count"] for op in COLLECTIVE_OPS),
+        "bytes": sum(stats[op]["bytes"] for op in COLLECTIVE_OPS),
+    }
+    return stats
+
+
+def comms_budget(compiled) -> dict:
+    """Budget dict for one compiled step (``lowered.compile()`` result)."""
+    return collective_stats(compiled.as_text())
+
+
+def check_budget(budget: Mapping[str, Any], golden: Mapping[str, Any],
+                 *, config: str) -> list[Finding]:
+    """Exact count fence + byte fence against the committed golden.
+
+    Counts must match exactly — one extra all-gather is precisely the
+    regression this pass exists to catch.  Bytes must match exactly too
+    (shapes are deterministic for a pinned jax/XLA); regenerate the golden
+    via ``python -m dtf_tpu.analysis --write-golden`` when a change is
+    intentional, and justify the diff in the PR.
+    """
+    findings = []
+    for op in COLLECTIVE_OPS + ("total",):
+        got = budget.get(op, {"count": 0, "bytes": 0})
+        want = golden.get(op, {"count": 0, "bytes": 0})
+        if got["count"] != want["count"]:
+            findings.append(Finding(
+                config, "hlo", "collective-count-drift", "error",
+                f"{op}: {got['count']} in compiled step vs {want['count']} "
+                f"in golden (regenerate with --write-golden if intended)"))
+        elif got["bytes"] != want["bytes"]:
+            findings.append(Finding(
+                config, "hlo", "collective-bytes-drift", "error",
+                f"{op}: {got['bytes']:,} B vs {want['bytes']:,} B golden "
+                f"(count unchanged — shapes/dtypes moved)"))
+    return findings
+
+
+def load_golden(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_golden(path: str, budgets: Mapping[str, Any], *, meta: dict) -> None:
+    doc = {"_meta": meta, "budgets": dict(sorted(budgets.items()))}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")

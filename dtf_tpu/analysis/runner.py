@@ -1,0 +1,87 @@
+"""Drive the analyzer passes over registry configs (shared by CLI and tests)."""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+from dtf_tpu.analysis import configs as cfgs
+from dtf_tpu.analysis import hlo as hlo_pass
+from dtf_tpu.analysis import jaxpr as jaxpr_pass
+from dtf_tpu.analysis import specs as specs_pass
+from dtf_tpu.analysis.findings import Finding
+
+GOLDEN_BASENAME = "STATIC_ANALYSIS.json"
+
+
+def golden_path() -> str:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(root, GOLDEN_BASENAME)
+
+
+def run_specs(config: cfgs.AnalysisConfig) -> list[Finding]:
+    """Rulebook + ZeRO-1 lints at real scale (eval_shape only)."""
+    mesh = config.mesh()
+    view = config.spec_view(mesh)
+    findings = specs_pass.lint_rules(
+        view.params, view.rules, dict(mesh.shape), config=config.name,
+        allow_dead=config.allow_dead, replicated_ok=config.replicated_ok)
+    for opt_name, make_tx in cfgs.OPTIMIZER_FAMILIES.items():
+        findings += specs_pass.lint_opt_specs(
+            make_tx(), view.params, view.rules, mesh, config=config.name,
+            opt_name=opt_name, zero1=view.zero1)
+        findings += specs_pass.lint_opt_specs(
+            make_tx(), view.params, view.rules, mesh, config=config.name,
+            opt_name=opt_name, zero1=False)
+    return findings
+
+
+def run_jaxpr(config: cfgs.AnalysisConfig, view=None) -> list[Finding]:
+    """Trace-level lints on the tiny train step (no compile)."""
+    view = view or config.step_view(config.mesh())
+    closed = jaxpr_pass.trace_step(view.step, view.state, view.batch)
+    return jaxpr_pass.lint_jaxpr(closed, config=config.name)
+
+
+def compile_budget(config: cfgs.AnalysisConfig, view=None) -> dict:
+    """AOT-compile the tiny train step and extract its comms budget."""
+    view = view or config.step_view(config.mesh())
+    compiled = view.step.lower(view.state, view.batch).compile()
+    return hlo_pass.comms_budget(compiled)
+
+
+def run_hlo(config: cfgs.AnalysisConfig, golden: dict,
+            view=None) -> list[Finding]:
+    budget = compile_budget(config, view)
+    want = golden.get("budgets", {}).get(config.name)
+    if want is None:
+        return [Finding(config.name, "hlo", "missing-golden", "error",
+                        f"no golden comms budget for {config.name!r}; "
+                        f"run `python -m dtf_tpu.analysis --write-golden`")]
+    return hlo_pass.check_budget(budget, want, config=config.name)
+
+
+def analyze(names: Sequence[str] | None = None,
+            passes: Sequence[str] = ("specs", "jaxpr", "hlo"),
+            golden: dict | None = None) -> list[Finding]:
+    """Run the requested passes over the requested configs."""
+    selected = (cfgs.REGISTRY if not names
+                else tuple(cfgs.BY_NAME[n] for n in names))
+    if "hlo" in passes and golden is None:
+        path = golden_path()
+        golden = (hlo_pass.load_golden(path) if os.path.exists(path)
+                  else {"budgets": {}})
+    findings: list[Finding] = []
+    for config in selected:
+        if "specs" in passes:
+            findings += run_specs(config)
+        # the step view (mesh + full train-step construction) is the
+        # expensive part — build it once and share across jaxpr/hlo
+        view = (config.step_view(config.mesh())
+                if {"jaxpr", "hlo"} & set(passes) else None)
+        if "jaxpr" in passes:
+            findings += run_jaxpr(config, view)
+        if "hlo" in passes:
+            findings += run_hlo(config, golden, view)
+    return findings

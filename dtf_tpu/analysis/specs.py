@@ -1,0 +1,204 @@
+"""Rulebook linting: every way a ``(regex → PartitionSpec)`` placement
+rulebook can be silently wrong, checked against the abstract param tree.
+
+The failure modes, each mapped to a check id:
+
+- ``dead-rule``       — a rule whose regex matches no leaf path at all
+  (typo'd pattern: the leaf it meant to place falls to REPLICATED).
+- ``shadowed-rule``   — a rule that matches leaves but never wins one
+  (an earlier rule takes every path first; first-match-wins,
+  :func:`dtf_tpu.core.sharding.spec_for`).
+- ``duplicate-axis``  — the same mesh axis named twice in one
+  PartitionSpec (invalid sharding; GSPMD rejects it only at compile time,
+  and only if the rule actually fires on the device path).
+- ``unknown-axis``    — a spec naming an axis the mesh doesn't have.
+- ``rank-overflow``   — spec longer than the leaf's rank.
+- ``indivisible-dim`` — a sharded dim not divisible by the product of its
+  mesh axes (gives ragged shards: silent padding or a compile error,
+  depending on path).
+- ``replicated-large-leaf`` — a leaf ≥ ``large_numel`` elements matched by
+  NO rule, silently replicated on every device (the classic "regex missed
+  the embedding table" failure). Small unmatched leaves (LN scales,
+  biases) are the intended default and reported as one ``info`` summary.
+
+``lint_opt_specs`` applies the same per-leaf spec checks to the ZeRO-1
+optimizer-state spec tree (:func:`dtf_tpu.core.sharding.zero1_opt_specs`)
+for an optimizer family, catching a ``_zero1_leaf_spec`` regression for
+any state layout (adam's param-shaped mu/nu, adafactor's rank-reduced
+factored moments, sgd's empty state).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping, Sequence
+
+import jax
+import optax
+from jax.sharding import PartitionSpec as P
+
+from dtf_tpu.analysis.findings import Finding
+from dtf_tpu.core import sharding as shd
+
+PyTree = Any
+
+#: leaves at or above this many elements must not silently replicate.
+LARGE_NUMEL = 1 << 20
+
+
+def _numel(shape: Sequence[int]) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _spec_entries(spec: P) -> list[tuple[str, ...]]:
+    """Normalize each spec entry to a tuple of axis names."""
+    out = []
+    for s in spec:
+        if s is None:
+            out.append(())
+        elif isinstance(s, str):
+            out.append((s,))
+        else:
+            out.append(tuple(s))
+    return out
+
+
+def check_spec(path: str, spec: P, shape: Sequence[int],
+               mesh_shape: Mapping[str, int], *, config: str,
+               where: str = "param") -> list[Finding]:
+    """Validate one resolved (leaf, spec) pair against the mesh shape."""
+    findings = []
+    entries = _spec_entries(spec)
+
+    def err(check, detail):
+        findings.append(Finding(config, "specs", check, "error",
+                                f"{where} {path}: {detail}"))
+
+    seen: set[str] = set()
+    for axes in entries:
+        for a in axes:
+            if a in seen:
+                err("duplicate-axis",
+                    f"mesh axis {a!r} used twice in spec {spec}")
+            seen.add(a)
+            if a not in mesh_shape:
+                err("unknown-axis",
+                    f"spec {spec} names axis {a!r}, mesh has "
+                    f"{sorted(mesh_shape)}")
+    if len(entries) > len(shape):
+        err("rank-overflow",
+            f"spec {spec} has {len(entries)} entries for rank-"
+            f"{len(shape)} leaf {tuple(shape)}")
+        return findings
+    for dim, axes in zip(shape, entries):
+        size = 1
+        for a in axes:
+            size *= mesh_shape.get(a, 1)
+        if size > 1 and dim % size:
+            err("indivisible-dim",
+                f"dim {dim} of {tuple(shape)} not divisible by "
+                f"{'*'.join(axes)}={size} (spec {spec})")
+    return findings
+
+
+def lint_rules(params: PyTree, rules: Sequence[shd.Rule],
+               mesh_shape: Mapping[str, int], *, config: str,
+               allow_dead: Sequence[str] = (),
+               replicated_ok: Sequence[str] = (),
+               large_numel: int = LARGE_NUMEL) -> list[Finding]:
+    """Lint a param rulebook against an abstract param tree.
+
+    ``params`` may be real arrays or ``jax.eval_shape`` output — only
+    ``.shape`` is read.  ``mesh_shape`` is ``mesh.shape`` (a Mapping), so
+    callers can lint against a hypothetical mesh without building one.
+
+    ``allow_dead``: rule patterns (exact strings) that may legitimately
+    match nothing in this config (shared rulebooks, e.g. the MoE expert
+    rule on a dense GPT) — downgraded to ``info``.
+    ``replicated_ok``: leaf-path regexes whose replication is the design
+    (pipeline embed/head).  With an EMPTY rulebook the large-leaf check is
+    skipped entirely: pure-DP configs replicate params by construction and
+    shard optimizer state via ZeRO-1 instead.
+    """
+    leaves, raw_hits, wins = shd.rule_matches(params, rules)
+    findings: list[Finding] = []
+
+    for i, (pattern, spec) in enumerate(rules):
+        if raw_hits[i] == 0:
+            sev = "info" if pattern in allow_dead else "error"
+            findings.append(Finding(
+                config, "specs", "dead-rule", sev,
+                f"rule {i} {pattern!r} -> {spec} matches no leaf path"
+                + (" (declared optional for this config)"
+                   if sev == "info" else "")))
+        elif wins[i] == 0:
+            findings.append(Finding(
+                config, "specs", "shadowed-rule", "error",
+                f"rule {i} {pattern!r} -> {spec} matches "
+                f"{raw_hits[i]} leaves but every one is taken by an "
+                f"earlier rule (first-match-wins)"))
+
+    small_replicated = 0
+    for leaf in leaves:
+        if leaf.rule_index is None:
+            intended = (not rules or any(
+                re.search(p, leaf.path) for p in replicated_ok))
+            if not intended and _numel(leaf.shape) >= large_numel:
+                findings.append(Finding(
+                    config, "specs", "replicated-large-leaf", "error",
+                    f"param {leaf.path} {leaf.shape} "
+                    f"({_numel(leaf.shape):,} elems) matched no rule and "
+                    f"silently falls to REPLICATED"))
+            else:
+                small_replicated += 1
+        else:
+            findings.extend(check_spec(leaf.path, leaf.spec, leaf.shape,
+                                       mesh_shape, config=config))
+    if small_replicated:
+        findings.append(Finding(
+            config, "specs", "replicated-small-leaves", "info",
+            f"{small_replicated} leaves fall to REPLICATED "
+            f"(intended: empty rulebook, declared-ok path, or "
+            f"< {large_numel:,} elems)"))
+    return findings
+
+
+def lint_opt_specs(tx: optax.GradientTransformation, params: PyTree,
+                   rules: Sequence[shd.Rule], mesh, *, config: str,
+                   opt_name: str = "opt", zero1: bool = True
+                   ) -> list[Finding]:
+    """Validate the optimizer-state spec tree the train state would use.
+
+    ``mesh`` needs only a ``.shape`` mapping (a real Mesh or a stand-in).
+    The spec tree is recomputed exactly the way ``state_specs`` does it,
+    then every (state leaf, spec) pair goes through :func:`check_spec`.
+    """
+    abstract_params = jax.eval_shape(lambda p: p, params)
+    param_specs = shd.tree_specs(abstract_params, rules)
+    if zero1:
+        opt_specs = shd.zero1_opt_specs(tx, abstract_params, param_specs,
+                                        mesh)
+    else:
+        opt_specs = shd.opt_specs_like_params(tx, abstract_params,
+                                              param_specs)
+    abstract_state = jax.eval_shape(tx.init, abstract_params)
+
+    findings: list[Finding] = []
+    state_leaves = jax.tree_util.tree_leaves_with_path(abstract_state)
+    spec_leaves = jax.tree.leaves(opt_specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+    if len(state_leaves) != len(spec_leaves):
+        findings.append(Finding(
+            config, "specs", "opt-spec-tree-mismatch", "error",
+            f"{opt_name}: {len(spec_leaves)} specs for "
+            f"{len(state_leaves)} state leaves"))
+        return findings
+    where = f"{opt_name} state" + ("" if zero1 else " (no zero1)")
+    for (path, leaf), spec in zip(state_leaves, spec_leaves):
+        findings.extend(check_spec(
+            shd.path_str(path), spec, leaf.shape, dict(mesh.shape),
+            config=config, where=where))
+    return findings

@@ -1,0 +1,134 @@
+"""Minimal source linter — the ``scripts/lint.sh`` fallback when pyflakes
+is not installed (the container policy is no new deps; see ISSUE/PR notes).
+
+Pyflakes-grade checks that matter for this codebase, AST-only (no
+imports executed):
+
+- syntax errors (files that won't even parse),
+- unused imports (module scope; ``# noqa`` and ``__init__.py`` re-exports
+  honored),
+- duplicate top-level definitions (a copy-pasted ``def test_x`` silently
+  shadowing the first is a real way to lose a test),
+- ``import *`` (kills static analysis),
+- ``except:`` bare handlers (swallow KeyboardInterrupt in launch loops).
+
+Usage: ``python -m dtf_tpu.analysis.srclint PATH [PATH ...]`` — prints one
+finding per line, exits 1 if any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Iterator
+
+
+def _py_files(paths: list[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def _noqa_lines(src: str) -> set[int]:
+    return {i for i, line in enumerate(src.splitlines(), 1)
+            if "# noqa" in line}
+
+
+class _Names(ast.NodeVisitor):
+    """Collect every identifier USED (loads + attribute roots)."""
+
+    def __init__(self):
+        self.used: set[str] = set()
+
+    def visit_Name(self, node):
+        self.used.add(node.id)
+
+    def visit_Attribute(self, node):
+        self.generic_visit(node)
+
+
+def lint_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
+
+    problems: list[str] = []
+    noqa = _noqa_lines(src)
+    is_init = os.path.basename(path) == "__init__.py"
+
+    names = _Names()
+    names.visit(tree)
+    # names referenced in module __all__ strings count as used
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            names.used.add(node.value)
+
+    # ---- unused imports (module top level only — conservative) ----
+    for node in tree.body:
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                if (not is_init and node.lineno not in noqa
+                        and bound not in names.used):
+                    problems.append(
+                        f"{path}:{node.lineno}: unused import {bound!r}")
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    problems.append(
+                        f"{path}:{node.lineno}: import * from "
+                        f"{node.module!r}")
+                    continue
+                bound = alias.asname or alias.name
+                if (not is_init and node.lineno not in noqa
+                        and bound not in names.used):
+                    problems.append(
+                        f"{path}:{node.lineno}: unused import {bound!r}")
+
+    # ---- duplicate top-level defs ----
+    seen: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.name in seen and node.lineno not in noqa:
+                problems.append(
+                    f"{path}:{node.lineno}: {node.name!r} redefines the "
+                    f"one at line {seen[node.name]}")
+            seen[node.name] = node.lineno
+
+    # ---- bare except ----
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ExceptHandler) and node.type is None
+                and node.lineno not in noqa):
+            problems.append(f"{path}:{node.lineno}: bare 'except:'")
+
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or ["dtf_tpu"]
+    problems = []
+    n = 0
+    for f in _py_files(paths):
+        n += 1
+        problems += lint_file(f)
+    for p in problems:
+        print(p)
+    print(f"srclint: {n} files, {len(problems)} problems")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -187,6 +187,38 @@ def make_optimizer(FLAGS, recipe, recipe_uses_wd=False):
     return wrap_optimizer(tx, FLAGS)
 
 
+#: optimizer families that apply weight decay themselves (decoupled decay);
+#: launchers whose recipes express regularization as loss-side L2 must drop
+#: the L2 when one of these is selected — and route the decay here instead.
+DECOUPLED_DECAY_OPTIMIZERS = ("adamw", "lamb", "adafactor")
+
+
+def resolve_loss_l2(FLAGS, recipe_l2: float):
+    """Loss-side L2 coefficient for launchers with an L2-based recipe.
+
+    When ``--optimizer`` picks a decoupled-decay family the loss-side L2
+    must be dropped (both would fire), so this returns 0.0 — but if
+    ``--weight_decay`` was left unset, the optimizer's own default decay
+    may be 0.0 (lamb) or None (adafactor), and the run would silently
+    train with NO regularization at all (ADVICE r5 #2). In that case the
+    recipe's coefficient is promoted into ``--weight_decay`` (consumed by
+    :func:`make_optimizer`) with a warning, so the recipe's regularization
+    strength survives the optimizer swap.
+    """
+    name = (getattr(FLAGS, "optimizer", "") or "").lower()
+    if name not in DECOUPLED_DECAY_OPTIMIZERS:
+        return FLAGS.weight_decay if FLAGS.weight_decay >= 0 else recipe_l2
+    if FLAGS.weight_decay < 0:
+        from absl import logging as absl_logging
+
+        FLAGS.weight_decay = recipe_l2
+        absl_logging.warning(
+            "--optimizer=%s drops the recipe's loss-side L2; defaulting "
+            "--weight_decay to the recipe's %g (decoupled decay). Pass "
+            "--weight_decay explicitly to override.", name, recipe_l2)
+    return 0.0
+
+
 def wrap_optimizer(tx, FLAGS):
     """Apply the optimizer-shaping train flags to a base optax transform.
 

@@ -19,7 +19,7 @@ automatically.
 from __future__ import annotations
 
 import re
-from typing import Any, Sequence
+from typing import Any, NamedTuple, Sequence
 
 import jax
 import optax
@@ -60,6 +60,53 @@ def tree_specs(tree: PyTree, rules: Sequence[Rule],
     """PartitionSpec pytree for ``tree`` (params) under ``rules``."""
     return jax.tree_util.tree_map_with_path(
         lambda path, leaf: spec_for(path_str(path), rules, default), tree)
+
+
+class LeafMatch(NamedTuple):
+    """One leaf's resolution against a rulebook (see :func:`rule_matches`)."""
+
+    path: str
+    shape: tuple[int, ...]
+    dtype: Any
+    rule_index: int | None   # winning rule (first match), None = default
+    spec: P
+
+
+def rule_matches(tree: PyTree, rules: Sequence[Rule],
+                 default: P = REPLICATED
+                 ) -> tuple[list[LeafMatch], list[int], list[int]]:
+    """Full first-match-wins resolution trace, for static analysis.
+
+    Returns ``(leaves, raw_hits, wins)`` where ``raw_hits[i]`` counts leaf
+    paths rule ``i``'s regex matches at all and ``wins[i]`` counts leaves it
+    actually places (i.e. no earlier rule matched).  A rule with
+    ``raw_hits == 0`` is dead; one with hits but ``wins == 0`` is shadowed.
+    This is the introspection surface ``dtf_tpu.analysis.specs`` builds on —
+    the matching semantics stay defined in one place (:func:`spec_for`).
+    """
+    raw_hits = [0] * len(rules)
+    wins = [0] * len(rules)
+    leaves: list[LeafMatch] = []
+
+    def visit(path, leaf):
+        p = path_str(path)
+        winner = None
+        for i, (pattern, spec) in enumerate(rules):
+            if re.search(pattern, p):
+                raw_hits[i] += 1
+                if winner is None:
+                    winner = (i, spec)
+        if winner is not None:
+            wins[winner[0]] += 1
+        spec = winner[1] if winner is not None else default
+        leaves.append(LeafMatch(p, tuple(getattr(leaf, "shape", ())),
+                                getattr(leaf, "dtype", None),
+                                winner[0] if winner is not None else None,
+                                spec))
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, tree)
+    return leaves, raw_hits, wins
 
 
 def tree_shardings(tree: PyTree, mesh: Mesh, rules: Sequence[Rule] = (),

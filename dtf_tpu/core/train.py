@@ -31,6 +31,7 @@ import optax
 from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dtf_tpu import _jax_compat as _compat
 from dtf_tpu.core import sharding as shd
 from dtf_tpu.core.comms import batch_sharding, global_norm
 
@@ -115,6 +116,25 @@ def state_shardings_from_specs(specs: TrainState, mesh: Mesh) -> TrainState:
                         is_leaf=lambda x: isinstance(x, P))
 
 
+def _full_init(init_fn: Callable[[jax.Array], PyTree],
+               tx: optax.GradientTransformation) -> Callable:
+    """rng -> TrainState builder shared by real and abstract construction."""
+
+    def init(rng):
+        variables = init_fn(rng)
+        params = variables["params"]
+        extra = {k: v for k, v in variables.items() if k != "params"}
+        return TrainState(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            extra=extra,
+            rng=rng,
+        )
+
+    return init
+
+
 def create_train_state(
     init_fn: Callable[[jax.Array], PyTree],
     tx: optax.GradientTransformation,
@@ -132,21 +152,31 @@ def create_train_state(
     """
     specs = state_specs(init_fn, tx, rng, mesh, param_rules, zero1=zero1)
     shardings = state_shardings_from_specs(specs, mesh)
-
-    def init(rng):
-        variables = init_fn(rng)
-        params = variables["params"]
-        extra = {k: v for k, v in variables.items() if k != "params"}
-        return TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            opt_state=tx.init(params),
-            extra=extra,
-            rng=rng,
-        )
-
-    state = jax.jit(init, out_shardings=shardings)(rng)
+    state = jax.jit(_full_init(init_fn, tx), out_shardings=shardings)(rng)
     return state, shardings
+
+
+def abstract_train_state(
+    init_fn: Callable[[jax.Array], PyTree],
+    tx: optax.GradientTransformation,
+    rng: jax.Array,
+    mesh: Mesh,
+    param_rules: Sequence[shd.Rule] = (),
+    *,
+    zero1: bool = True,
+) -> tuple[TrainState, TrainState]:
+    """:func:`create_train_state` without touching a device.
+
+    Returns ``(abstract_state, shardings)`` where the state's leaves are
+    ``jax.ShapeDtypeStruct``s — exactly what AOT lowering
+    (``step.lower(abstract_state, abstract_batch)``) and the static
+    analyzer (:mod:`dtf_tpu.analysis`) need: the compiled collective mix
+    can be inspected with zero device memory or compute for the state.
+    """
+    specs = state_specs(init_fn, tx, rng, mesh, param_rules, zero1=zero1)
+    shardings = state_shardings_from_specs(specs, mesh)
+    abstract = jax.eval_shape(_full_init(init_fn, tx), rng)
+    return abstract, shardings
 
 
 def make_train_step(
@@ -261,7 +291,11 @@ def make_train_step(
         step_fn,
         in_shardings=(shardings, batch_sh),
         out_shardings=(shardings, NamedSharding(mesh, P())),
-        donate_argnums=(0,) if donate else (),
+        # donation is version-gated: on pre-0.5 jax a DONATED executable
+        # deserialized from the persistent compile cache drops aliased
+        # outputs (warm-run BN stats freeze; see tests/conftest.py note) —
+        # the sim has memory headroom, the real-chip env has new jax.
+        donate_argnums=(0,) if donate and not _compat.BACKFILLED else (),
     )
 
 
@@ -308,7 +342,11 @@ def make_train_step_from_grads(
         step_fn,
         in_shardings=(shardings, batch_sh),
         out_shardings=(shardings, NamedSharding(mesh, P())),
-        donate_argnums=(0,) if donate else (),
+        # donation is version-gated: on pre-0.5 jax a DONATED executable
+        # deserialized from the persistent compile cache drops aliased
+        # outputs (warm-run BN stats freeze; see tests/conftest.py note) —
+        # the sim has memory headroom, the real-chip env has new jax.
+        donate_argnums=(0,) if donate and not _compat.BACKFILLED else (),
     )
 
 

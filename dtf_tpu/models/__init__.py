@@ -5,3 +5,25 @@
 - bert: BERT-base encoder MLM pretraining
 - widedeep: Wide&Deep recsys with row-sharded embedding tables
 """
+
+
+def rulebooks():
+    """name → param-placement rulebook, one entry per model that ships one.
+
+    The registration point the static analyzer builds on
+    (``dtf_tpu.analysis.configs`` wires each rulebook to its mesh/step
+    construction): a new model's rules added here are one registry entry
+    away from full rule-lint + comms-budget coverage. Imports stay lazy —
+    this package must be importable without pulling every model.
+    """
+    from dtf_tpu.models import bert, gpt, gpt_pipe, gpt_pipe_tp, widedeep
+
+    return {
+        "mnist": (),                       # pure DP: ZeRO-1 shards opt state
+        "resnet": (),                      # pure DP
+        "bert": tuple(bert.tp_rules),
+        "widedeep": tuple(widedeep.rules),
+        "gpt": tuple(gpt.tp_rules),
+        "gpt_pipe": tuple(gpt_pipe.pipe_rules()),
+        "gpt_pipe_tp": tuple(gpt_pipe_tp.pipe_tp_rules()),
+    }

@@ -41,7 +41,13 @@ from dtf_tpu.parallel import moe as moe_lib
 
 @dataclasses.dataclass(frozen=True)
 class GPTConfig:
-    vocab_size: int = 50257
+    #: GPT-2's 50257 BPE vocab padded to a multiple of 128 (the Megatron /
+    #: nanoGPT convention): the embedding rows and lm_head columns shard
+    #: evenly over any power-of-two `model` axis AND tile the TPU lane
+    #: width; 50257 would leave every TP shard ragged (caught by
+    #: `python -m dtf_tpu.analysis` as indivisible-dim). The 47 pad tokens
+    #: never appear in data; their logits just ride the softmax.
+    vocab_size: int = 50304
     d_model: int = 768
     layers: int = 12
     heads: int = 12
@@ -258,6 +264,9 @@ class CausalSelfAttention(nn.Module):
         """
         cfg = self.cfg
         is_initialized = self.has_variable("cache", "cached_key")
+        # NOTE: a new cache variable must also be added to
+        # _BATCH_LED_CACHE_KEYS / _NON_BATCH_CACHE_KEYS below (beam search
+        # reorders batch-led leaves by key path and asserts completeness).
         cache_len = (min(cfg.decode_len, self.window)
                      if self.window else cfg.decode_len)
         quant = cfg.kv_cache_dtype == "int8"
@@ -801,6 +810,15 @@ def _prefill(model: GPT, params, cache0, prompt, prefill_chunk: int):
     return logits, mut["cache"]
 
 
+#: cache-collection leaves whose leading dim is the batch (beam search
+#: clones and reorders exactly these); every other cache key must appear in
+#: _NON_BATCH_CACHE_KEYS, so an unrecognized leaf fails loudly instead of
+#: silently riding the beams unreordered.
+_BATCH_LED_CACHE_KEYS = frozenset(
+    {"cached_key", "cached_value", "key_scale", "value_scale"})
+_NON_BATCH_CACHE_KEYS = frozenset({"cache_index"})
+
+
 def generate_beam(model: GPT, params, prompt: jax.Array, n_new: int, *,
                   num_beams: int = 4,
                   eos_id: Optional[int] = None, pad_id: int = 0,
@@ -822,8 +840,8 @@ def generate_beam(model: GPT, params, prompt: jax.Array, n_new: int, *,
     no in-scan sequence buffers.
 
     Composes with ``prefill_chunk`` (shared :func:`_prefill`) and any
-    ``model.cfg`` cache variant (GQA / rolling window / int8 — the
-    reorder walks whatever leaves the cache collection has). Sharded
+    ``model.cfg`` cache variant (GQA / rolling window / int8 — batch-led
+    leaves are selected by key path, see ``_BATCH_LED_CACHE_KEYS``). Sharded
     (mesh) decode is not wired for beams; shard the batch outside.
     """
     cfg = model.cfg
@@ -847,18 +865,39 @@ def generate_beam(model: GPT, params, prompt: jax.Array, n_new: int, *,
     cache0 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                           shapes["cache"])
     logits, cache = _prefill(model, params, cache0, prompt, prefill_chunk)
-    cache = jax.tree.map(
-        lambda leaf: (jnp.repeat(leaf, k, axis=0)
-                      if getattr(leaf, "ndim", 0) >= 1
-                      and leaf.shape[0] == b else leaf), cache)
+
+    # Batch-led cache leaves are selected BY KEY PATH, not by leading-dim
+    # size: a future leaf with a colliding shape[0] must not be silently
+    # (mis)reordered, and a renamed batch-led leaf must fail loudly here
+    # rather than ride the beams unreordered. The shape check is demoted to
+    # an assertion on the selected leaves.
+    def _map_batch_led(fn, cache, lead):
+        def per_leaf(path, leaf):
+            name = getattr(path[-1], "key", str(path[-1]))
+            if name in _BATCH_LED_CACHE_KEYS:
+                assert getattr(leaf, "ndim", 0) >= 1 and \
+                    leaf.shape[0] == lead, (
+                        f"cache leaf {name!r} expected leading dim "
+                        f"{lead}, got {getattr(leaf, 'shape', None)}")
+                return fn(leaf)
+            if name not in _NON_BATCH_CACHE_KEYS:
+                # a hard error, not an assert: silently riding the beams
+                # unreordered corrupts decode output (and -O strips asserts)
+                raise ValueError(
+                    f"unknown cache leaf {name!r}: add it to "
+                    "_BATCH_LED_CACHE_KEYS or _NON_BATCH_CACHE_KEYS so "
+                    "beam search knows whether to reorder it")
+            return leaf
+
+        return jax.tree_util.tree_map_with_path(per_leaf, cache)
+
+    cache = _map_batch_led(lambda leaf: jnp.repeat(leaf, k, axis=0),
+                           cache, b)
     logits = jnp.repeat(logits[:, -1:], k, axis=0)           # [B*k, 1, V]
 
     def reorder(cache, parent):
         rows = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
-        return jax.tree.map(
-            lambda leaf: (leaf[rows]
-                          if getattr(leaf, "ndim", 0) >= 1
-                          and leaf.shape[0] == b * k else leaf), cache)
+        return _map_batch_led(lambda leaf: leaf[rows], cache, b * k)
 
     def expand(scores, logprobs, done):
         """(scores [B,k], logprobs [B,k,V], done [B,k]) -> top-k beams:

@@ -55,10 +55,12 @@ _STAT_LANES = 128  # scratch stat arrays are [block_q, 128] (TPU lane width)
 
 
 def _compiler_params(dims: tuple[str, ...]):
-    fields = {f.name for f in dataclasses.fields(pltpu.CompilerParams)}
+    # pre-0.5 jax spells it TPUCompilerParams; same dataclass either way.
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    fields = {f.name for f in dataclasses.fields(cls)}
     if "dimension_semantics" in fields:
-        return pltpu.CompilerParams(dimension_semantics=dims)
-    return pltpu.CompilerParams()
+        return cls(dimension_semantics=dims)
+    return cls()
 
 
 def _positions(i, j, block_q, block_k):

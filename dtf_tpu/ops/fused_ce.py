@@ -269,6 +269,19 @@ def _fused_ce_bwd(ignore_index, block_n, block_v, interpret, axis_names,
         # a replicated (P()) OUTPUT's cotangent arrives divided by the
         # shard count, and the replicated w INPUT's cotangent is psum'd
         # by shard_map itself. So: undo the division here, add no psum.
+        #
+        # VERSION TRIPWIRE (ADVICE r5 #1): both halves of that convention
+        # are UNSPECIFIED shard_map internals under check_vma=False — a
+        # JAX upgrade is free to change either, which would silently
+        # mis-scale dx and dw by a factor of the shard count. The fast-
+        # tier parity tests
+        #   tests/test_fused_ce.py::test_sharded_matches_unsharded_grads
+        #   tests/test_fused_ce.py::test_gpt_loss_pallas_matches_full
+        # are the mandatory guards: they compare these gradients against
+        # the unsharded path and MUST stay in the `not slow` tier. If they
+        # start failing after a jax bump, re-measure the convention here
+        # (or restructure: per-shard sums out of the custom_vjp, explicit
+        # psum outside it, under a vma-checked shard_map).
         g_mean = g_mean * jax.lax.psum(1.0, axis_names)
     dce = (g_mean / cnt) * valid               # [N] (cnt is already global)
     dx, dw = _run_bwd(x, w, labels, lse, dce, block_n, block_v, interpret)

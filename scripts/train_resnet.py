@@ -54,6 +54,12 @@ def main(argv):
         model, shape, kind = resnet.resnet50(), (224, 224, 3), "imagenet"
 
     sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
+    # Recipe regularization is the classic 1e-4 L2 on kernels. When
+    # --optimizer picks a decoupled-decay family the loss-side L2 is
+    # dropped and the 1e-4 moves into --weight_decay (with a warning)
+    # unless the user set one — resolve BEFORE make_optimizer so the
+    # promoted default is actually consumed (cli/flags.resolve_loss_l2).
+    loss_l2 = dflags.resolve_loss_l2(FLAGS, recipe_l2=1e-4)
     tx = dflags.make_optimizer(
         FLAGS, lambda s: optax.sgd(s, momentum=0.9, nesterov=True),
         recipe_uses_wd=True)   # consumed as loss-side L2 below
@@ -61,14 +67,7 @@ def main(argv):
         resnet.make_init(model, shape), tx, jax.random.PRNGKey(FLAGS.seed),
         mesh)
     step = tr.make_train_step(
-        # shared --weight_decay flag (cli/flags.py): -1 = recipe default,
-        # the classic 1e-4 L2 on kernels. When --optimizer picks a
-        # decoupled-decay family the optimizer applies the decay itself,
-        # so the loss-side L2 is dropped — otherwise both would fire.
-        resnet.make_loss(model, weight_decay=(
-            0.0 if FLAGS.optimizer in ("adamw", "lamb", "adafactor")
-            else FLAGS.weight_decay if FLAGS.weight_decay >= 0 else 1e-4)),
-        tx, mesh,
+        resnet.make_loss(model, weight_decay=loss_l2), tx, mesh,
         shardings, grad_accum=FLAGS.grad_accum)
 
     from dtf_tpu.data import formats

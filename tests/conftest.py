@@ -34,6 +34,13 @@ import pytest  # noqa: E402
 # weak #5). With the cache, a warm full-pyramid run spends seconds where a
 # cold one spends minutes. Safe across code edits — the cache key hashes
 # the HLO, not the Python source.
+#
+# On 0.4.x CPU an executable deserialized from this cache used to drop
+# mutable-collection outputs for DONATED steps (warm-run BN stats froze;
+# bisected via test_resnet20_trains_and_updates_bn cold-pass/warm-fail).
+# core/train.py now version-gates donation off on backfilled jax
+# (_jax_compat.BACKFILLED), which makes cached executables safe again —
+# keep that gate in mind before re-enabling donation there.
 jax.config.update("jax_compilation_cache_dir",
                   os.path.join(_ROOT, ".jax_cache"))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -1,0 +1,322 @@
+"""Static analyzer (dtf_tpu/analysis): negative-path fixtures must be
+caught, shipping configs must be clean, and the comms-budget fence must
+trip on an injected collective."""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dtf_tpu.analysis import configs as cfgs
+from dtf_tpu.analysis import hlo
+from dtf_tpu.analysis import jaxpr as aj
+from dtf_tpu.analysis import runner
+from dtf_tpu.analysis import specs as asp
+from dtf_tpu.analysis.findings import errors
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: a stand-in mesh: specs-pass functions only read ``.shape``.
+MESH42 = types.SimpleNamespace(shape={"data": 4, "model": 2})
+
+PARAMS = {
+    "embed": {"embedding": jax.ShapeDtypeStruct((1 << 11, 1 << 10),
+                                                jnp.float32)},
+    "dense": {"kernel": jax.ShapeDtypeStruct((16, 8), jnp.float32),
+              "bias": jax.ShapeDtypeStruct((8,), jnp.float32)},
+}
+GOOD_RULES = [
+    (r"embed/embedding", P("model", None)),
+    (r"kernel", P(None, "model")),
+]
+
+
+def _checks(findings):
+    return {f.check for f in errors(findings)}
+
+
+# ------------------------------------------------------------- specs pass
+
+def test_clean_rulebook_has_no_findings():
+    assert not errors(asp.lint_rules(
+        PARAMS, GOOD_RULES, MESH42.shape, config="fix"))
+
+
+def test_dead_rule_detected():
+    rules = GOOD_RULES + [(r"no_such_leaf", P("model"))]
+    assert "dead-rule" in _checks(
+        asp.lint_rules(PARAMS, rules, MESH42.shape, config="fix"))
+
+
+def test_shadowed_rule_detected():
+    # matches kernels, but the earlier generic rule wins every path
+    rules = GOOD_RULES + [(r"dense/kernel", P("model", None))]
+    assert "shadowed-rule" in _checks(
+        asp.lint_rules(PARAMS, rules, MESH42.shape, config="fix"))
+
+
+def test_duplicate_mesh_axis_detected():
+    rules = [(r"kernel", P("model", "model"))]
+    assert "duplicate-axis" in _checks(
+        asp.lint_rules(PARAMS, rules, MESH42.shape, config="fix"))
+
+
+def test_indivisible_dim_detected():
+    # dim 6 sharded over data=4 -> ragged shards
+    params = {"w": jax.ShapeDtypeStruct((6, 8), jnp.float32)}
+    assert "indivisible-dim" in _checks(asp.lint_rules(
+        params, [(r"w", P("data", None))], MESH42.shape, config="fix"))
+
+
+def test_rank_overflow_detected():
+    rules = GOOD_RULES + [(r"bias", P(None, "model"))]
+    assert "rank-overflow" in _checks(
+        asp.lint_rules(PARAMS, rules, MESH42.shape, config="fix"))
+
+
+def test_unknown_axis_detected():
+    assert "unknown-axis" in _checks(asp.lint_rules(
+        PARAMS, [(r"kernel", P(None, "modle"))],   # typo'd axis
+        MESH42.shape, config="fix"))
+
+
+def test_large_replicated_leaf_detected():
+    # embedding (2^21 elems) matched by NO rule while other rules exist
+    rules = [(r"kernel", P(None, "model"))]
+    assert "replicated-large-leaf" in _checks(
+        asp.lint_rules(PARAMS, rules, MESH42.shape, config="fix"))
+
+
+def test_large_replicated_leaf_ok_when_declared_or_dp():
+    rules = [(r"kernel", P(None, "model"))]
+    ok = asp.lint_rules(PARAMS, rules, MESH42.shape, config="fix",
+                        replicated_ok=(r"^embed/",))
+    assert not errors(ok)
+    # pure-DP (empty rulebook) replicates everything by design
+    assert not errors(asp.lint_rules(PARAMS, (), MESH42.shape, config="fix"))
+
+
+@pytest.mark.parametrize("opt_name", sorted(cfgs.OPTIMIZER_FAMILIES))
+def test_zero1_specs_clean_for_every_optimizer_family(opt_name):
+    tx = cfgs.OPTIMIZER_FAMILIES[opt_name]()
+    for zero1 in (True, False):
+        findings = asp.lint_opt_specs(
+            tx, PARAMS, GOOD_RULES, MESH42, config="fix",
+            opt_name=opt_name, zero1=zero1)
+        assert not errors(findings), findings
+
+
+def test_zero1_catches_bad_param_spec_propagation():
+    # a duplicate-axis param spec propagates into the zero1 state specs
+    rules = [(r"kernel", P("model", "model"))]
+    findings = asp.lint_opt_specs(
+        optax.adam(1e-3), PARAMS, rules, MESH42, config="fix")
+    assert "duplicate-axis" in _checks(findings)
+
+
+# ------------------------------------------------------------- jaxpr pass
+
+def test_jaxpr_flags_collective_outside_shard_map():
+    closed = jax.make_jaxpr(
+        jax.vmap(lambda x: jax.lax.psum(x, "i"), axis_name="i"))(
+            jnp.ones((4, 2)))
+    assert "collective-outside-shard-map" in {
+        f.check for f in aj.lint_jaxpr(closed, config="fix")}
+
+
+def test_jaxpr_allows_collective_inside_shard_map(mesh8):
+    def f(x):
+        return jax.shard_map(lambda y: jax.lax.psum(y, "data"), mesh=mesh8,
+                             in_specs=P("data"), out_specs=P())(x)
+
+    closed = jax.make_jaxpr(jax.jit(f))(jnp.ones(8))
+    assert not aj.lint_jaxpr(closed, config="fix")
+
+
+def test_jaxpr_flags_host_callback():
+    def f(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v), jax.ShapeDtypeStruct(x.shape, x.dtype),
+            x)
+
+    closed = jax.make_jaxpr(f)(jnp.ones(4))
+    assert "host-callback" in {
+        f.check for f in aj.lint_jaxpr(closed, config="fix")}
+
+
+def test_jaxpr_flags_float64_leak():
+    from jax.experimental import enable_x64
+
+    with enable_x64():
+        closed = jax.make_jaxpr(
+            lambda x: x.astype(jnp.float64) * 2.0)(jnp.ones(4))
+    assert "float64-leak" in {
+        f.check for f in aj.lint_jaxpr(closed, config="fix")}
+
+
+# --------------------------------------------------------------- hlo pass
+
+_FAKE_HLO = """
+HloModule jit_step
+fused_computation {
+  ROOT t = f32[8,4]{1,0} add(p0, p1)
+}
+ENTRY main {
+  ar = f32[16,8]{1,0} all-reduce(x), replica_groups={}
+  ag.1 = bf16[4,2]{1,0} all-gather(y), dimensions={0}
+  start = (f32[8]{0}, f32[8]{0}) all-reduce-start(z)
+  done = f32[8]{0} all-reduce-done(start)
+  cp = u32[2]{0} collective-permute(w), source_target_pairs={{0,1}}
+  ROOT r = f32[] constant(0)
+}
+"""
+
+
+def test_collective_stats_counts_and_bytes():
+    stats = hlo.collective_stats(_FAKE_HLO)
+    # all-reduce: plain (16*8*4 B) + start (two f32[8] = 64 B); done skipped
+    assert stats["all-reduce"]["count"] == 2
+    assert stats["all-reduce"]["bytes"] == 16 * 8 * 4 + 2 * 8 * 4
+    assert stats["all-gather"] == {"count": 1, "bytes": 4 * 2 * 2}
+    assert stats["collective-permute"] == {"count": 1, "bytes": 2 * 4}
+    assert stats["reduce-scatter"]["count"] == 0
+    assert stats["total"]["count"] == 4
+
+
+def test_budget_fence_trips_on_injected_collective():
+    stats = hlo.collective_stats(_FAKE_HLO)
+    golden = copy.deepcopy(stats)
+    assert not hlo.check_budget(stats, golden, config="fix")
+    golden["all-gather"]["count"] += 1          # a resharding crept in
+    findings = hlo.check_budget(stats, golden, config="fix")
+    assert "collective-count-drift" in {f.check for f in findings}
+
+
+def test_injected_resharding_allgather_detected(mesh8):
+    """A spec change that makes XLA move a weight shows up in the budget."""
+    w = jax.ShapeDtypeStruct((16, 8), jnp.float32)
+
+    def loss(w):
+        return (w @ jnp.ones((8, 4))).sum()
+
+    clean = jax.jit(
+        loss, in_shardings=NamedSharding(mesh8, P())).lower(w).compile()
+    resharded = jax.jit(
+        loss, in_shardings=NamedSharding(mesh8, P("data", None))
+    ).lower(w).compile()
+    b_clean = hlo.comms_budget(clean)
+    b_resh = hlo.comms_budget(resharded)
+    assert b_clean["total"]["count"] == 0
+    assert b_resh["total"]["count"] > 0
+    assert hlo.check_budget(b_resh, b_clean, config="fix")
+
+
+# ------------------------------------------- shipping configs + the fence
+
+@pytest.mark.parametrize("name", sorted(cfgs.BY_NAME))
+def test_shipping_config_specs_clean(name):
+    assert not errors(runner.run_specs(cfgs.BY_NAME[name]))
+
+
+@pytest.mark.parametrize("name", ["mnist", "bert", "gpt_pipe"])
+def test_shipping_config_jaxpr_clean(name):
+    assert not errors(runner.run_jaxpr(cfgs.BY_NAME[name]))
+
+
+GOLDEN = runner.golden_path()
+FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert"]
+
+
+@pytest.mark.parametrize("name", FAST_BUDGET_CONFIGS)
+def test_comms_budget_matches_golden(name):
+    golden = hlo.load_golden(GOLDEN)
+    assert name in golden["budgets"], (
+        f"no golden for {name}; run python -m dtf_tpu.analysis "
+        f"--write-golden")
+    budget = runner.compile_budget(cfgs.BY_NAME[name])
+    findings = hlo.check_budget(budget, golden["budgets"][name],
+                                config=name)
+    assert not findings, findings
+    # DP gradient mean must ride an all-reduce in every train step
+    assert budget["all-reduce"]["count"] > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name", sorted(set(cfgs.BY_NAME) - set(FAST_BUDGET_CONFIGS)))
+def test_comms_budget_matches_golden_slow(name):
+    golden = hlo.load_golden(GOLDEN)
+    budget = runner.compile_budget(cfgs.BY_NAME[name])
+    assert not hlo.check_budget(budget, golden["budgets"][name],
+                                config=name)
+
+
+# ------------------------------------------------------------ CLI + lint
+
+def _env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = ROOT
+    env["_DTF_TPU_ANALYSIS_REEXEC"] = "1"   # already pinned by this env
+    return env
+
+
+def test_cli_smoke_json_line():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis", "--configs=mnist",
+         "--passes=specs,jaxpr"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=300)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert out["ok"] is True and out["findings"] == 0
+
+
+def test_cli_unknown_config_is_structured_error():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis", "--configs=nope"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=120)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 2 and out["ok"] is False
+
+
+@pytest.mark.slow
+def test_cli_full_run_zero_findings():
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=1500)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 0, (proc.stderr[-2000:], out)
+    assert out["ok"] is True and out["findings"] == 0, out
+
+
+def test_lint_script_clean():
+    proc = subprocess.run(
+        ["bash", os.path.join(ROOT, "scripts", "lint.sh")],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-500:]
+
+
+def test_every_registered_rulebook_is_analyzed(mesh8):
+    """models.rulebooks() is the registration point; every non-empty
+    rulebook there must be exercised by at least one registry config (a
+    new model's rules must not silently escape analysis)."""
+    from dtf_tpu.models import rulebooks
+
+    analyzed = set()
+    for c in cfgs.REGISTRY:
+        view = c.spec_view(c.mesh())
+        analyzed.update(pat for pat, _ in view.rules)
+    for name, rules in rulebooks().items():
+        missing = [pat for pat, _ in rules if pat not in analyzed]
+        assert not missing, (name, missing)

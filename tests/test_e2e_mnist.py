@@ -28,7 +28,12 @@ def _fit(mesh, steps, ckpt=None, model_kind="softmax", lr=0.1):
 
 
 def test_mnist_softmax_learns(mesh8):
-    state, step_fn, model = _fit(mesh8, 60)
+    # lr 0.03 / 100 steps: smooth convergence to ~0.69 eval accuracy. The
+    # _fit default lr=0.1 oscillates on this workload (raw [0,1) pixels),
+    # landing anywhere in 0.08-0.7 depending on backend rounding — the
+    # assertion then flakes across jax versions. Lower lr tests the same
+    # property (the e2e slice learns) deterministically above the bar.
+    state, step_fn, model = _fit(mesh8, 100, lr=0.03)
     # evaluate on an unseen batch of the same distribution (the synthetic
     # label map is seed-specific, so held-out means same seed, unseen step).
     eval_fn = tr.make_eval_step(m.make_eval(model), mesh8,

@@ -219,3 +219,34 @@ def test_adafactor_zero1_specs_are_valid(mesh8):
         256, 256).astype(np.float32)
     state, metrics = step(state, shard_batch(batch, mesh8))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_decoupled_decay_promotes_recipe_l2():
+    """ADVICE r5 #2: --optimizer=lamb/adafactor with no --weight_decay must
+    not silently drop ALL regularization when a launcher's recipe is
+    loss-side L2 — the recipe coefficient moves into --weight_decay."""
+    from dtf_tpu.cli.flags import resolve_loss_l2
+
+    # decoupled family, wd unset: loss L2 dropped, recipe 1e-4 promoted
+    f = fl(optimizer="lamb")
+    assert resolve_loss_l2(f, recipe_l2=1e-4) == 0.0
+    assert f.weight_decay == pytest.approx(1e-4)
+    tx = make_optimizer(f, optax.sgd, recipe_uses_wd=True)
+    assert tx is not None   # lamb now carries the promoted decay
+
+    # decoupled family, wd set explicitly: respected, not overwritten
+    f = fl(optimizer="adafactor", weight_decay=0.3)
+    assert resolve_loss_l2(f, recipe_l2=1e-4) == 0.0
+    assert f.weight_decay == pytest.approx(0.3)
+
+    # recipe path (no override): L2 stays on the loss side
+    f = fl()
+    assert resolve_loss_l2(f, recipe_l2=1e-4) == pytest.approx(1e-4)
+    assert f.weight_decay == -1.0
+    f = fl(weight_decay=0.05)
+    assert resolve_loss_l2(f, recipe_l2=1e-4) == pytest.approx(0.05)
+
+    # non-decoupled override keeps the loss-side L2 at the recipe value
+    f = fl(optimizer="momentum")
+    assert resolve_loss_l2(f, recipe_l2=1e-4) == pytest.approx(1e-4)
+    assert f.weight_decay == -1.0
