@@ -118,7 +118,9 @@ def main(argv: list[str] | None = None) -> int:
 
         golden = (hlo_pass.load_golden(golden_file)
                   if os.path.exists(golden_file) else {"budgets": {}})
-        findings = runner.analyze(names or None, passes, golden=golden)
+        budgets: dict = {}
+        findings = runner.analyze(names or None, passes, golden=golden,
+                                  budgets_out=budgets)
     except Exception as e:  # noqa: BLE001 — last line must still be JSON
         print(json.dumps({"ok": False,
                           "error": f"{type(e).__name__}: {e}"[:500]}))
@@ -134,6 +136,15 @@ def main(argv: list[str] | None = None) -> int:
         "details": [f.to_json() for f in findings
                     if f.severity != "info"][:50],
     }
+    if budgets:
+        # per-config collective-bytes delta vs the committed golden, so a
+        # PR's comms cost shows up in its analysis line (0 everywhere on a
+        # clean fence; a drift here pairs with a hlo finding above).
+        gb = golden.get("budgets", {})
+        out["comms_delta_bytes"] = {
+            name: b["total"]["bytes"]
+            - gb.get(name, {}).get("total", {}).get("bytes", 0)
+            for name, b in sorted(budgets.items())}
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

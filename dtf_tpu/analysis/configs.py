@@ -295,6 +295,12 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    # the shared GPT rulebook carries the MoE expert rule;
                    # dense flagship has no expert params.
                    allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_overlap", MeshConfig(data=2, seq=2, model=2),
+                   _gpt_spec(tp_overlap=True), _gpt_step(tp_overlap=True),
+                   # --tp_overlap: the fence pins the intended collective
+                   # swap — TP-layer all-gather/reduce-scatter traffic
+                   # becomes collective-permute rings (docs/OVERLAP.md).
+                   allow_dead=(r"w_(in|out)$",)),
     AnalysisConfig("gpt_moe", MeshConfig(data=4, expert=2),
                    _gpt_spec(moe_every=2), _gpt_step(moe_every=2)),
     AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),

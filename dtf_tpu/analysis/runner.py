@@ -52,8 +52,9 @@ def compile_budget(config: cfgs.AnalysisConfig, view=None) -> dict:
 
 
 def run_hlo(config: cfgs.AnalysisConfig, golden: dict,
-            view=None) -> list[Finding]:
-    budget = compile_budget(config, view)
+            view=None, budget: dict | None = None) -> list[Finding]:
+    if budget is None:
+        budget = compile_budget(config, view)
     want = golden.get("budgets", {}).get(config.name)
     if want is None:
         return [Finding(config.name, "hlo", "missing-golden", "error",
@@ -64,8 +65,14 @@ def run_hlo(config: cfgs.AnalysisConfig, golden: dict,
 
 def analyze(names: Sequence[str] | None = None,
             passes: Sequence[str] = ("specs", "jaxpr", "hlo"),
-            golden: dict | None = None) -> list[Finding]:
-    """Run the requested passes over the requested configs."""
+            golden: dict | None = None,
+            budgets_out: dict | None = None) -> list[Finding]:
+    """Run the requested passes over the requested configs.
+
+    ``budgets_out``: pass a dict to receive each analyzed config's compiled
+    comms budget (the CLI reports the per-config collective-bytes delta vs
+    golden from it, so a PR's comms cost is visible in the JSON line).
+    """
     selected = (cfgs.REGISTRY if not names
                 else tuple(cfgs.BY_NAME[n] for n in names))
     if "hlo" in passes and golden is None:
@@ -83,5 +90,8 @@ def analyze(names: Sequence[str] | None = None,
         if "jaxpr" in passes:
             findings += run_jaxpr(config, view)
         if "hlo" in passes:
-            findings += run_hlo(config, golden, view)
+            budget = compile_budget(config, view)
+            if budgets_out is not None:
+                budgets_out[config.name] = budget
+            findings += run_hlo(config, golden, view, budget=budget)
     return findings

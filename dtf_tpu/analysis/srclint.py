@@ -10,7 +10,11 @@ imports executed):
 - duplicate top-level definitions (a copy-pasted ``def test_x`` silently
   shadowing the first is a real way to lose a test),
 - ``import *`` (kills static analysis),
-- ``except:`` bare handlers (swallow KeyboardInterrupt in launch loops).
+- ``except:`` bare handlers (swallow KeyboardInterrupt in launch loops),
+- direct ``jax.lax.all_gather``/``psum_scatter`` calls in ``models/`` —
+  model code must route TP collectives through ``dtf_tpu.core.comms``
+  (one choke point: the comms-budget fence and the ``--tp_overlap``
+  collective-matmul dispatch both live behind it).
 
 Usage: ``python -m dtf_tpu.analysis.srclint PATH [PATH ...]`` — prints one
 finding per line, exits 1 if any.
@@ -113,6 +117,38 @@ def lint_file(path: str) -> list[str]:
         if (isinstance(node, ast.ExceptHandler) and node.type is None
                 and node.lineno not in noqa):
             problems.append(f"{path}:{node.lineno}: bare 'except:'")
+
+    # ---- direct lax collectives in models/ (must route through comms) ----
+    # absolute path + segment test (a relative `srclint gpt.py` run from
+    # inside models/ must still be fenced; `submodels/` must not be),
+    # anchored on the package root: only segments AFTER the last
+    # `dtf_tpu` count, so a checkout living under some ancestor named
+    # "models" (/home/ml/models/repo/...) doesn't fence the whole tree.
+    # Without a `dtf_tpu` anchor (fixtures, scratch files) only the
+    # immediate parent directory counts.
+    dirs = os.path.abspath(path).replace(os.sep, "/").split("/")[:-1]
+    if "dtf_tpu" in dirs:
+        dirs = dirs[len(dirs) - dirs[::-1].index("dtf_tpu"):]
+        in_models = "models" in dirs
+    else:
+        in_models = bool(dirs) and dirs[-1] == "models"
+    if in_models:
+        fenced = ("all_gather", "psum_scatter")
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in fenced
+                    and node.lineno not in noqa):
+                continue
+            base = node.func.value    # jax.lax.X or lax.X
+            is_lax = (isinstance(base, ast.Name) and base.id == "lax") or (
+                isinstance(base, ast.Attribute) and base.attr == "lax")
+            if is_lax:
+                problems.append(
+                    f"{path}:{node.lineno}: direct jax.lax."
+                    f"{node.func.attr} in models/ — route through "
+                    f"dtf_tpu.core.comms (the comms-budget fence and "
+                    f"--tp_overlap dispatch choke point)")
 
     return problems
 

@@ -219,6 +219,75 @@ def resolve_loss_l2(FLAGS, recipe_l2: float):
     return 0.0
 
 
+#: v5e HBM per chip; the loss-path picker budgets against a fraction of it
+#: because params + optimizer state + activations share the pool.
+HBM_BYTES_PER_CHIP = 16e9
+#: monolithic [B,T,V] f32 logits + their cotangent must fit inside this
+#: fraction of HBM to pick the fast path. Calibrated against the on-chip
+#: map (PERF.md §0c): GPT-2-small b8 s1024 (3.3 GB) fits and runs 9 MFU
+#: points faster unchunked; b16 (6.6 GB) is where throughput falls over.
+LOGITS_HBM_FRACTION = 0.25
+#: the token-chunk width the sweep banked as the fast bounded-memory shape
+#: (one full-vocab MXU matmul per block — PERF.md §0c).
+AUTO_LOSS_CHUNK_TOKENS = 4096
+
+
+def resolve_lm_loss(FLAGS, *, batch: int, seq_len: int, vocab_size: int,
+                    mesh_shape=None, hbm_bytes: float = HBM_BYTES_PER_CHIP):
+    """Pick the LM loss path from an HBM estimate (PERF.md §0c).
+
+    The vocab-chunked loss is a MEMORY lever, not a speed lever: it costs
+    ~9 MFU points on GPT and ~5 on BERT versus the monolithic [B,T,V]
+    matmul+CE that XLA fuses. So: when no fused-loss flag is set and the
+    full logits plus their cotangent fit comfortably per device, keep the
+    monolithic path; when they don't, auto-select the token-chunked fused
+    loss (the faster chunking axis on chip). When an EXPLICIT flag forces
+    a fused path even though the logits fit, warn — the user is paying
+    MFU for memory they don't need — but honor the flag.
+
+    Returns ``(loss_chunk_vocab, loss_chunk_tokens)``; ``--loss_pallas``
+    and the TP/pipe restrictions are handled by the launchers (fused
+    losses don't compose with a sharded head, so under ``mesh_model > 1``
+    or ``mesh_pipe > 1`` this keeps the monolithic path).
+    """
+    from absl import logging as absl_logging
+
+    mesh_shape = mesh_shape or {}
+    lchunk = getattr(FLAGS, "loss_chunk_vocab", 0)
+    tchunk = getattr(FLAGS, "loss_chunk_tokens", 0)
+    lpallas = getattr(FLAGS, "loss_pallas", False)
+    # per-device token share: logits shard over the data and seq axes
+    shards = max(mesh_shape.get("data", 1), 1) * max(
+        mesh_shape.get("seq", 1), 1)
+    # f32 logits + cotangent live simultaneously through the backward
+    est = 2 * (batch * seq_len / shards) * vocab_size * 4
+    fits = est <= LOGITS_HBM_FRACTION * hbm_bytes
+    if lchunk or tchunk or lpallas:
+        if fits:
+            which = ("--loss_chunk_vocab" if lchunk else
+                     "--loss_chunk_tokens" if tchunk else "--loss_pallas")
+            absl_logging.warning(
+                "%s forces a fused LM loss but the monolithic [B,T,V] "
+                "logits fit (est %.2f GB/device of %.0f GB HBM): the "
+                "chunked path costs ~9 GPT MFU points (PERF.md 0c) — "
+                "drop the flag to let the HBM estimate pick", which,
+                est / 1e9, hbm_bytes / 1e9)
+        return lchunk, tchunk
+    if fits:
+        return 0, 0
+    if (mesh_shape.get("model", 1) > 1 or mesh_shape.get("pipe", 1) > 1):
+        # fused losses don't compose with a vocab-sharded head / the
+        # pipelined loss; the monolithic path is the only legal one here
+        return 0, 0
+    absl_logging.warning(
+        "monolithic [B,T,V] logits estimated at %.2f GB/device (> %d%% of "
+        "%.0f GB HBM): auto-selecting the token-chunked fused loss "
+        "(chunk=%d); pass --loss_chunk_tokens/--loss_chunk_vocab to "
+        "override", est / 1e9, int(LOGITS_HBM_FRACTION * 100),
+        hbm_bytes / 1e9, AUTO_LOSS_CHUNK_TOKENS)
+    return 0, AUTO_LOSS_CHUNK_TOKENS
+
+
 def wrap_optimizer(tx, FLAGS):
     """Apply the optimizer-shaping train flags to a base optax transform.
 

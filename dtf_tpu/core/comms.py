@@ -23,6 +23,7 @@ from typing import Any, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
+from flax import linen as nn
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 PyTree = Any
@@ -150,3 +151,131 @@ def global_norm(tree: PyTree) -> jax.Array:
     """L2 norm over a pytree (for grad-norm logging/clipping)."""
     leaves = jax.tree.leaves(tree)
     return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
+
+
+# ---------------------------------------------------------------------------
+# Megatron TP projection dispatch (the --tp_overlap choke point).
+# ---------------------------------------------------------------------------
+
+def tp_overlap_viable(x_shape: Sequence[int], features_in: int,
+                      features_out: int, mesh: Mesh | None, *,
+                      parallel: str, axis: str = "model") -> bool:
+    """Can this projection take the collective-matmul ring path?
+
+    The ring needs: a real TP axis; [B, T, D] activations whose batch and
+    token dims split evenly over ('data') x ('seq', axis); and the sharded
+    feature dim divisible by the axis (columns of W for the column-parallel
+    projection, rows of W = activation features for the row-parallel one).
+    Anything else — tp=1, decode's t=1/ragged chunks, non-3D inputs — falls
+    back to the plain einsum, where GSPMD's blocking collectives are
+    correct, just not overlapped.
+    """
+    if mesh is None:
+        return False
+    n = mesh.shape.get(axis, 1)
+    if n <= 1 or len(x_shape) != 3:
+        return False
+    token_shards = mesh.shape.get("seq", 1) * n
+    if x_shape[0] % mesh.shape.get("data", 1) or x_shape[1] % token_shards:
+        return False
+    sharded_f = features_out if parallel == "column" else features_in
+    return sharded_f % n == 0
+
+
+def tp_token_sharded(x: jax.Array, mesh: Mesh | None, *,
+                     axis: str = "model") -> jax.Array:
+    """Pin the Megatron sequence-parallel residual-stream layout: [B, T, D]
+    tokens sharded over ('seq', axis), features whole.
+
+    Without this constraint GSPMD is free to resolve the residual add by
+    ALL-GATHERING :func:`matmul_rs`'s token-sharded output back to the
+    replicated layout — re-inserting exactly the blocking collective the
+    overlap path removes. Pinned, the stream stays token-sharded across
+    residual adds / layernorms / dropout, and the only remaining gather is
+    the one the LM head genuinely needs. No-op when the layout cannot
+    apply (trivial axis, non-3D, indivisible dims).
+    """
+    if (mesh is None or x.ndim != 3
+            or mesh.shape.get(axis, 1) <= 1
+            or x.shape[0] % mesh.shape.get("data", 1)
+            or x.shape[1] % (mesh.shape.get("seq", 1) * mesh.shape[axis])):
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("data", ("seq", axis), None)))
+
+
+def tp_activation_gathered(x: jax.Array, mesh: Mesh | None) -> jax.Array:
+    """Leave the Megatron-SP layout with ONE activation gather over the TP
+    axis: [B, T, D] pinned back to P('data', 'seq', None).
+
+    Pin this at the embed exit and the LM/MLM head entry. Without it GSPMD
+    may satisfy a vocab-sharded table consumer by all-gathering the [V, D]
+    embedding/head TABLE instead — ruinous at a 50k vocab, invisible at
+    tiny test scale. No-op without a mesh.
+    """
+    if mesh is None:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, P("data", "seq", None)))
+
+
+def tp_dense(x: jax.Array, kernel: jax.Array, bias: jax.Array | None,
+             mesh: Mesh | None, *, parallel: str, overlap: bool = False,
+             dtype=None, axis: str = "model") -> jax.Array:
+    """Apply one Megatron TP projection — THE dispatch point the models
+    route through (srclint fences direct ``jax.lax`` collectives out of
+    ``models/``; see docs/OVERLAP.md).
+
+    ``parallel='column'``: kernel [D, F] placed P(None, axis) (q/k/v,
+    mlp_in — output features sharded). ``parallel='row'``: kernel [F, D]
+    placed P(axis, None) (attn_out, mlp_out — contracting features
+    sharded). ``overlap=True`` routes through the latency-hiding ppermute
+    rings of :mod:`dtf_tpu.ops.collective_matmul` when
+    :func:`tp_overlap_viable`; otherwise this is exactly the einsum
+    ``nn.Dense`` performs and GSPMD schedules the (blocking) collectives.
+    """
+    if parallel not in ("column", "row"):
+        raise ValueError(f"parallel={parallel!r} must be 'column' or 'row'")
+    if dtype is not None:
+        x = x.astype(dtype)
+        kernel = kernel.astype(dtype)
+        bias = bias.astype(dtype) if bias is not None else None
+    if overlap and tp_overlap_viable(
+            x.shape, kernel.shape[0], kernel.shape[1], mesh,
+            parallel=parallel, axis=axis):
+        from dtf_tpu.ops import collective_matmul as cm
+
+        if parallel == "column":
+            y = cm.ag_matmul_sharded(x, kernel, mesh, axis=axis)
+        else:
+            y = cm.matmul_rs_sharded(x, kernel, mesh, axis=axis)
+    else:
+        y = jnp.einsum("...td,df->...tf", x, kernel)
+    return y if bias is None else y + bias
+
+
+class TpDense(nn.Module):
+    """Drop-in ``nn.Dense`` for Megatron TP projections: same param
+    names/shapes/init (kernel [in, features] lecun-normal, zeros bias), so
+    rulebooks, checkpoints and parity tests see an identical tree — only
+    the matmul routes through :func:`tp_dense`, which swaps GSPMD's
+    blocking all-gather/reduce-scatter for the collective-matmul ring when
+    ``overlap`` is on and the shapes allow it."""
+
+    features: int
+    mesh: Mesh | None
+    parallel: str                 # 'column' | 'row'
+    overlap: bool = True
+    use_bias: bool = True
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kernel = self.param("kernel", nn.initializers.lecun_normal(),
+                            (x.shape[-1], self.features), self.param_dtype)
+        bias = (self.param("bias", nn.initializers.zeros,
+                           (self.features,), self.param_dtype)
+                if self.use_bias else None)
+        return tp_dense(x, kernel, bias, self.mesh, parallel=self.parallel,
+                        overlap=self.overlap, dtype=self.dtype)

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.core import comms
 from dtf_tpu.core.train import LossAux
 from dtf_tpu.ops import attention as att
 from dtf_tpu.ops import flash_attention as fa
@@ -47,6 +48,10 @@ class BertConfig:
     #: attention backend for the non-seq-sharded path: auto (flash kernel on
     #: TPU, dense elsewhere) | dense | flash. Seq sharding always rings.
     attn_impl: str = "auto"
+    #: latency-hiding collective matmul for the TP projections (q/k/v +
+    #: attn_out, mlp_in/mlp_out) — same semantics as
+    #: :attr:`dtf_tpu.models.gpt.GPTConfig.tp_overlap` (docs/OVERLAP.md).
+    tp_overlap: bool = False
 
     @staticmethod
     def base() -> "BertConfig":
@@ -79,8 +84,13 @@ class SelfAttention(nn.Module):
     def __call__(self, x, pad_mask, deterministic: bool):
         cfg = self.cfg
         d_head = cfg.hidden // cfg.heads
-        dense = lambda name: nn.Dense(  # noqa: E731
-            cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        # comms.TpDense is a drop-in nn.Dense (identical param tree); under
+        # --tp_overlap the projections become collective matmuls, otherwise
+        # its dispatch is the plain einsum.
+        overlap = cfg.tp_overlap and self.mesh is not None
+        dense = lambda name: comms.TpDense(  # noqa: E731
+            cfg.hidden, self.mesh, "column", overlap=overlap,
+            dtype=cfg.dtype, name=name)
         # [B,T,Hd] → [B,H,T,D]
         def split(t):
             return t.reshape(t.shape[0], t.shape[1], cfg.heads,
@@ -112,8 +122,8 @@ class SelfAttention(nn.Module):
                     f"unknown attn_impl {impl!r} (auto | dense | flash)")
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], x.shape[1],
                                                 cfg.hidden)
-        out = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
-                       name="attn_out")(out)
+        out = comms.TpDense(cfg.hidden, self.mesh, "row", overlap=overlap,
+                            dtype=cfg.dtype, name="attn_out")(out)
         out = nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
         return out
 
@@ -125,16 +135,23 @@ class EncoderLayer(nn.Module):
     @nn.compact
     def __call__(self, x, pad_mask, deterministic: bool):
         cfg = self.cfg
+        overlap = cfg.tp_overlap and self.mesh is not None
         a = SelfAttention(cfg, self.mesh, name="attention")(
             x, pad_mask, deterministic)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + a)
-        h = nn.Dense(cfg.intermediate, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="mlp_in")(x)
+        if overlap:
+            # hold the Megatron-SP token-sharded layout through the
+            # post-LN residual points (comms.tp_token_sharded docstring)
+            x = comms.tp_token_sharded(x, self.mesh)
+        h = comms.TpDense(cfg.intermediate, self.mesh, "column",
+                          overlap=overlap, dtype=cfg.dtype,
+                          name="mlp_in")(x)
         h = nn.gelu(h, approximate=True)
-        h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
-                     name="mlp_out")(h)
+        h = comms.TpDense(cfg.hidden, self.mesh, "row", overlap=overlap,
+                          dtype=cfg.dtype, name="mlp_out")(h)
         h = nn.Dropout(cfg.dropout)(h, deterministic=deterministic)
-        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+        out = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + h)
+        return comms.tp_token_sharded(out, self.mesh) if overlap else out
 
 
 class BertMLM(nn.Module):
@@ -160,6 +177,10 @@ class BertMLM(nn.Module):
         for i in range(cfg.layers):
             x = EncoderLayer(cfg, self.mesh, name=f"layer_{i}")(
                 x, pad_mask, deterministic)
+        if cfg.tp_overlap and self.mesh is not None:
+            # leave the Megatron-SP layout before the tied decode below
+            # reads the vocab-sharded embedding TABLE
+            x = comms.tp_activation_gathered(x, self.mesh)
         # MLM head: dense+gelu+LN then tied decode (embedding^T) + bias.
         h = nn.Dense(cfg.hidden, dtype=cfg.dtype, param_dtype=jnp.float32,
                      name="mlm_dense")(x)

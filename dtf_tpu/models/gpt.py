@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.core import comms
 from dtf_tpu.core.train import LossAux
 from dtf_tpu.ops import attention as att
 from dtf_tpu.ops import flash_attention as fa
@@ -100,6 +101,14 @@ class GPTConfig:
     #: (``generate(..., prefill_chunk=...)``). Static flag — the default
     #: one-shot prefill keeps its flash-kernel fast path.
     chunked_prefill: bool = False
+    #: latency-hiding collective matmul for the Megatron TP projections
+    #: (q/k/v + attn_out, mlp_in/mlp_out): the blocking all-gather /
+    #: reduce-scatter GSPMD schedules around each sharded einsum becomes a
+    #: ppermute ring overlapped with per-chunk matmuls
+    #: (:mod:`dtf_tpu.ops.collective_matmul`; docs/OVERLAP.md). Exact
+    #: numerics parity with the GSPMD path; no-op unless the mesh has a
+    #: real 'model' axis and shapes divide (comms.tp_overlap_viable).
+    tp_overlap: bool = False
 
     def __post_init__(self):
         if self.kv_heads is not None and (
@@ -292,8 +301,21 @@ class CausalSelfAttention(nn.Module):
         kv_heads = cfg.kv_heads_resolved
         group = cfg.heads // kv_heads
         t = x.shape[1]
-        dense = lambda name, nh: nn.Dense(  # noqa: E731
-            nh * d_head, dtype=cfg.dtype, param_dtype=jnp.float32, name=name)
+        # ONE projection constructor for every branch (train + decode):
+        # comms.TpDense is a drop-in nn.Dense (identical param tree). With
+        # --tp_overlap, q/k/v become collective ag_matmuls and attn_out a
+        # collective matmul_rs; otherwise (and in every non-viable shape,
+        # e.g. decode's t=1) its dispatch is the plain einsum. PP x SP
+        # stages run inside a manual shard_map already, where a nested one
+        # would be illegal — hence the manual_seq gate.
+        overlap = (cfg.tp_overlap and self.mesh is not None
+                   and not self.manual_seq)
+        dense = lambda name, nh: comms.TpDense(  # noqa: E731
+            nh * d_head, self.mesh, "column", overlap=overlap,
+            dtype=cfg.dtype, name=name)
+        out_dense = lambda: comms.TpDense(  # noqa: E731
+            cfg.d_model, self.mesh, "row", overlap=overlap,
+            dtype=cfg.dtype, name="attn_out")
 
         def split(v, nh):
             return v.reshape(v.shape[0], t, nh, d_head).transpose(0, 2, 1, 3)
@@ -364,8 +386,7 @@ class CausalSelfAttention(nn.Module):
                              vals, preferred_element_type=jnp.float32)
             out = out.astype(cfg.dtype).transpose(0, 3, 1, 2, 4)
             out = out.reshape(b, t, cfg.d_model)
-            return nn.Dense(cfg.d_model, dtype=cfg.dtype,
-                            param_dtype=jnp.float32, name="attn_out")(out)
+            return out_dense()(out)
 
         if cfg.decode_len > 0 and t != 1:
             # PREFILL: the whole prompt in one causal forward (parallel,
@@ -413,8 +434,7 @@ class CausalSelfAttention(nn.Module):
             out = jnp.einsum("bkgl,bkld->bkgd", p.astype(vals.dtype),
                              vals, preferred_element_type=jnp.float32)
             out = out.astype(cfg.dtype).reshape(b, 1, cfg.d_model)
-            return nn.Dense(cfg.d_model, dtype=cfg.dtype,
-                            param_dtype=jnp.float32, name="attn_out")(out)
+            return out_dense()(out)
 
         impl = cfg.attn_impl
         seq_sharded = (self.mesh is not None
@@ -518,8 +538,7 @@ class CausalSelfAttention(nn.Module):
             out = att.dense_attention(q, k, v, causal=True,
                                       window=self.window)
         out = out.transpose(0, 2, 1, 3).reshape(x.shape[0], t, cfg.d_model)
-        out = nn.Dense(cfg.d_model, dtype=cfg.dtype, param_dtype=jnp.float32,
-                       name="attn_out")(out)
+        out = out_dense()(out)
         return nn.Dropout(cfg.dropout)(out, deterministic=deterministic)
 
 
@@ -533,21 +552,34 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, deterministic: bool):
         cfg = self.cfg
+        overlap = (cfg.tp_overlap and self.mesh is not None
+                   and not self.manual_seq)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + CausalSelfAttention(cfg, self.mesh, self.window,
                                     manual_seq=self.manual_seq,
                                     name="attention")(h, deterministic)
+        if overlap:
+            x = comms.tp_token_sharded(x, self.mesh)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
         if self.use_moe:
             y = moe_lib.SwitchFFN(cfg.d_model, cfg.d_ff, cfg.moe,
                                   dtype=cfg.dtype, name="moe")(h)
         else:
-            y = nn.Dense(cfg.d_ff, dtype=cfg.dtype, param_dtype=jnp.float32,
-                         name="mlp_in")(h)
+            # the Megatron pair (collective matmuls under overlap; gelu
+            # runs on the feature-sharded activations in between, and the
+            # residual stream stays token-sharded over ('seq','model'))
+            y = comms.TpDense(cfg.d_ff, self.mesh, "column",
+                              overlap=overlap, dtype=cfg.dtype,
+                              name="mlp_in")(h)
             y = nn.gelu(y, approximate=True)
-            y = nn.Dense(cfg.d_model, dtype=cfg.dtype,
-                         param_dtype=jnp.float32, name="mlp_out")(y)
+            y = comms.TpDense(cfg.d_model, self.mesh, "row",
+                              overlap=overlap, dtype=cfg.dtype,
+                              name="mlp_out")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
+        if overlap:
+            # keep the residual stream in the Megatron-SP token-sharded
+            # layout between blocks (comms.tp_token_sharded docstring)
+            return comms.tp_token_sharded(x + y, self.mesh)
         return x + y
 
 
@@ -563,9 +595,18 @@ class GPT(nn.Module):
     def __call__(self, input_ids, *, deterministic: bool = True,
                  return_hidden: bool = False):
         cfg = self.cfg
+        overlap = cfg.tp_overlap and self.mesh is not None
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
                      param_dtype=jnp.float32, name="token_embed")(input_ids)
+        if overlap:
+            # pin the embed OUTPUT to the baseline batch layout first (the
+            # vocab-sharded masked-lookup + psum spelling, no table
+            # gather), then enter the Megatron-SP layout with a local
+            # slice below.
+            x = comms.tp_activation_gathered(x, self.mesh)
         x = nn.Dropout(cfg.dropout)(x, deterministic=deterministic)
+        if overlap:
+            x = comms.tp_token_sharded(x, self.mesh)
         block = Block
         if cfg.remat:
             block = nn.remat(Block, static_argnums=(2,))
@@ -579,6 +620,11 @@ class GPT(nn.Module):
             # below must still exist at init time, which it does — init
             # always runs with return_hidden=False
             return x
+        if overlap:
+            # the ONE gather the head genuinely needs (Megatron-SP): the
+            # ACTIVATIONS come back over the TP axis for the vocab-parallel
+            # head matmul — never the [D, V] head kernel.
+            x = comms.tp_activation_gathered(x, self.mesh)
         logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=jnp.float32,
                           param_dtype=jnp.float32, name="lm_head")(x)
         return logits

@@ -319,6 +319,29 @@ def tpu_main():
     parse = lambda line: (json.loads(line[len(SENTINEL):])  # noqa: E731
                           if line.startswith(SENTINEL) else None)
 
+    if "--sweep-blocks-bwd" in sys.argv:
+        # the still-unmeasured BACKWARD block rows alone (ISSUE 2): the
+        # full --sweep-blocks queue runs last in the pipeline and both
+        # round-5 windows died before reaching its bwd tail, so this
+        # standalone pass banks the five bwd rows early. Fwd stays pinned
+        # at its sweep winner (512x1024); (512,1024) repeats as the
+        # same-window control row.
+        jobs = [{"DTF_ATTN_SEQ": "8192",
+                 "DTF_ATTN_BQB": str(bqb), "DTF_ATTN_BKB": str(bkb)}
+                for bqb, bkb in ((512, 512), (1024, 512), (512, 1024),
+                                 (1024, 1024), (256, 1024))]
+
+        def on_result(row, job, rows, errs):
+            tpu = _read_artifact().get("tpu", {})
+            tpu["bwd_block_sweep"] = {"rows": rows, "errors": errs}
+            _merge_artifact("tpu", tpu)
+            print(json.dumps(row if row is not None else errs[-1]))
+
+        rows, errs = run_budgeted_jobs(
+            jobs, argv, parse, budget=budget, cap_s=TPU_CHILD_TIMEOUT_S,
+            env_base=dict(os.environ), on_result=on_result)
+        return 0 if rows else 1
+
     if "--sweep-blocks" in sys.argv:
         # MXU-roof block-shape search (VERDICT r3 #4) at the headline seq:
         # square vs rectangular vs larger blocks, one child each.

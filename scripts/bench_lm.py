@@ -96,6 +96,19 @@ def child():
         fbh = int(os.environ.get("DTF_LM_FLASH_BH", "0"))
         if fbh:  # flash head-fold knob (must divide heads; sweep-only)
             cfg = dataclasses.replace(cfg, flash_block_h=fbh)
+        # Megatron TP A/B (the --tp_overlap pair): a model axis plus the
+        # collective-matmul toggle. On a 1-chip tunnel mesh_model>1 fails
+        # fast -> a structured error row; the pair banks automatically the
+        # first time a multi-chip pool answers.
+        tp = int(os.environ.get("DTF_LM_MESH_MODEL", "1"))
+        overlap = os.environ.get("DTF_LM_TP_OVERLAP") == "1"
+        if tp > 1:
+            from dtf_tpu.core.mesh import MeshConfig
+
+            mesh = make_mesh(MeshConfig(model=tp))
+            row["n_chips"] = mesh.devices.size
+        if overlap:
+            cfg = dataclasses.replace(cfg, tp_overlap=True)
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=seq)
         tx = optax.adamw(1e-4, weight_decay=0.01)
         state, shardings = tr.create_train_state(
@@ -116,7 +129,7 @@ def child():
                    gpt_size="tiny" if tiny else size,
                    n_params=int(_count_params(state.params)), zero1=True,
                    loss_chunk=lchunk, loss_chunk_tokens=tchunk,
-                   loss_pallas=lpallas)
+                   loss_pallas=lpallas, mesh_model=tp, tp_overlap=overlap)
         unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
@@ -314,6 +327,24 @@ def main():
             {"DTF_LM_WHICH": "bert", "DTF_LM_MLM_GATHER": "96"},
         ]
         artifact = os.path.join(ROOT, "BENCH_LM_SWEEP_BERT.json")
+    elif "--sweep-tp-overlap" in sys.argv:
+        # the Megatron TP A/B pair (ISSUE 2): identical config, collective
+        # matmul off/on — the on-chip number that decides whether the
+        # ppermute rings hide ICI time behind MXU time. Needs >= 2 chips;
+        # a 1-chip tunnel records a structured mesh error instead.
+        G = "gpt"
+        jobs = [
+            {"DTF_LM_WHICH": G, "DTF_LM_MESH_MODEL": "2"},
+            {"DTF_LM_WHICH": G, "DTF_LM_MESH_MODEL": "2",
+             "DTF_LM_TP_OVERLAP": "1"},
+            # medium at TP2: wider matmuls give the rings more MXU time
+            # to hide behind — the shape the overlap should win on
+            {"DTF_LM_WHICH": G, "DTF_LM_GPT_SIZE": "medium",
+             "DTF_LM_MESH_MODEL": "2"},
+            {"DTF_LM_WHICH": G, "DTF_LM_GPT_SIZE": "medium",
+             "DTF_LM_MESH_MODEL": "2", "DTF_LM_TP_OVERLAP": "1"},
+        ]
+        artifact = os.path.join(ROOT, "BENCH_LM_TP_OVERLAP.json")
     elif "--phases-gpt" in sys.argv:
         # fwd / fwd+bwd / full-step decomposition: pins a low MFU on fwd
         # math, bwd math, or the optimizer tail by subtraction.

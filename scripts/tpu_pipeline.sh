@@ -6,17 +6,23 @@
 # banked the full attention sweep): smoke stays first as the cheap
 # correctness gate, then everything whose rows are missing or stale —
 # the LM benches now measure the sweep-picked 512x1024 flash default
-# (expected to lift GPT past the 58.0% MFU banked on 512x512), decode +
-# cost-table re-run with the host-readback fence fix, bench.py retries
-# the headline the 04:38 tunnel death swallowed. The attention sweeps,
-# fully banked at the old default, re-run last to re-measure at the new
-# one if the window survives that long.
+# (expected to lift GPT past the 58.0% MFU banked on 512x512), the
+# still-unmeasured rows ride next (the --tp_overlap collective-matmul
+# A/B pair — needs a multi-chip pool, a 1-chip tunnel banks a structured
+# mesh error — and the standalone bwd-block sweep both round-5 windows
+# died before reaching), decode + cost-table re-run with the
+# host-readback fence fix, bench.py retries the headline the 04:38
+# tunnel death swallowed. The full attention sweeps, banked at the old
+# default, re-run last to re-measure at the new one if the window
+# survives that long.
 set -x
 cd "$(dirname "$0")/.." || exit 1
 python scripts/tpu_smoke.py
 python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --sweep-bert
+python scripts/bench_lm.py --sweep-tp-overlap
+python scripts/bench_attention.py tpu --sweep-blocks-bwd
 python scripts/bench_decode.py
 python scripts/bench_cost_table.py
 python bench.py

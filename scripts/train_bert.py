@@ -27,6 +27,9 @@ flags.DEFINE_string("size", "base", "base | tiny")
 flags.DEFINE_boolean("zero1", True, "shard optimizer state over data axis")
 flags.DEFINE_string("attn_impl", "auto", "auto (flash on TPU) | dense | "
                     "flash — non-seq-sharded attention backend")
+flags.DEFINE_boolean("tp_overlap", False, "latency-hiding collective "
+                     "matmul for the Megatron TP projections (needs "
+                     "--mesh_model>1; docs/OVERLAP.md)")
 flags.DEFINE_integer("eval_every", 0, "held-out MLM eval (val.bin or "
                      "held-out synthetic) every N steps; 0 = final only")
 flags.DEFINE_integer("loss_chunk_vocab", 0, "compute the MLM loss fused "
@@ -61,11 +64,19 @@ def main(argv):
     mesh, info = setup(FLAGS)
     sp = mesh.shape.get("seq", 1) > 1
 
+    if FLAGS.tp_overlap and mesh.shape.get("model", 1) <= 1:
+        absl_logging.warning(
+            "--tp_overlap has no effect without --mesh_model>1 (no TP "
+            "collectives to hide); proceeding on the plain path")
     cfg = (bert.BertConfig.base() if FLAGS.size == "base"
            else bert.BertConfig.tiny())
-    cfg = dataclasses.replace(cfg, attn_impl=FLAGS.attn_impl)
-    model, init_fn = bert.make_init(cfg, mesh if sp else None,
-                                    seq_len=FLAGS.seq_len)
+    cfg = dataclasses.replace(cfg, attn_impl=FLAGS.attn_impl,
+                              tp_overlap=FLAGS.tp_overlap)
+    # the collective-matmul path needs the mesh in the model (tp_overlap);
+    # otherwise keep the historical mesh-less construction off SP.
+    model, init_fn = bert.make_init(
+        cfg, mesh if (sp or FLAGS.tp_overlap) else None,
+        seq_len=FLAGS.seq_len)
     sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
     tx = dflags.make_optimizer(
         FLAGS, lambda s: optax.adamw(s, weight_decay=(

@@ -44,6 +44,12 @@ flags.DEFINE_integer("attn_global_every", 0, "with attn_window: every "
                      "local/global; 0 = all layers windowed)")
 flags.DEFINE_string("attn_impl", "auto", "auto | dense | flash | ring | "
                     "zigzag (load-balanced causal ring; needs mesh_seq>1)")
+flags.DEFINE_boolean("tp_overlap", False, "latency-hiding collective "
+                     "matmul for the Megatron TP projections: decompose "
+                     "the blocking all-gather/reduce-scatter around each "
+                     "sharded einsum into a ppermute ring overlapped with "
+                     "per-chunk matmuls (needs --mesh_model>1; "
+                     "docs/OVERLAP.md)")
 flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
                      "mesh_pipe>1 (0 = 4x stages, the bubble-amortizing "
                      "default)")
@@ -104,11 +110,21 @@ def main(argv):
         raise app.UsageError(f"--size: {e.args[0]}")
     import dataclasses
 
+    if FLAGS.tp_overlap and mesh.shape.get("model", 1) <= 1:
+        absl_logging.warning(
+            "--tp_overlap has no effect without --mesh_model>1 (no TP "
+            "collectives to hide); proceeding on the plain path")
+    if FLAGS.tp_overlap and mesh.shape.get("pipe", 1) > 1:
+        raise app.UsageError(
+            "--tp_overlap is not supported with --mesh_pipe: pipeline "
+            "stages run mesh-less (gpt_pipe) or with their own manual TP "
+            "(gpt_pipe_tp), so the flag would be silently dropped")
     cfg = dataclasses.replace(base, moe_every=FLAGS.moe_every,
                               remat=FLAGS.remat, attn_impl=FLAGS.attn_impl,
                               kv_heads=FLAGS.kv_heads or None,
                               attn_window=FLAGS.attn_window,
                               attn_global_every=FLAGS.attn_global_every,
+                              tp_overlap=FLAGS.tp_overlap,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
     sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
@@ -214,12 +230,18 @@ def main(argv):
                 "over the vocab dim, which fused application would fight "
                 "(all-gathering W per chunk)")
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
-        loss_fn = gpt.make_loss(model, loss_chunk=FLAGS.loss_chunk_vocab,
-                                loss_chunk_tokens=FLAGS.loss_chunk_tokens,
+        # auto loss path: monolithic logits when they fit HBM (fastest),
+        # token-chunked fused CE when they don't; explicit flags win but
+        # warn when they force the ~9-MFU-point slower path (PERF.md 0c)
+        lchunk, tchunk = dflags.resolve_lm_loss(
+            FLAGS, batch=FLAGS.batch_size, seq_len=FLAGS.seq_len,
+            vocab_size=cfg.vocab_size, mesh_shape=dict(mesh.shape))
+        loss_fn = gpt.make_loss(model, loss_chunk=lchunk,
+                                loss_chunk_tokens=tchunk,
                                 loss_pallas=FLAGS.loss_pallas)
         param_rules = gpt.tp_rules
-        eval_fn = gpt.make_eval(model, loss_chunk=FLAGS.loss_chunk_vocab,
-                                loss_chunk_tokens=FLAGS.loss_chunk_tokens,
+        eval_fn = gpt.make_eval(model, loss_chunk=lchunk,
+                                loss_chunk_tokens=tchunk,
                                 loss_pallas=FLAGS.loss_pallas)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,

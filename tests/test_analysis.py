@@ -300,6 +300,60 @@ def test_cli_full_run_zero_findings():
     assert out["ok"] is True and out["findings"] == 0, out
 
 
+def test_srclint_fences_direct_collectives_in_models(tmp_path):
+    """ISSUE 2 satellite: models/ must route TP collectives through
+    core.comms — a direct jax.lax.all_gather/psum_scatter there escapes
+    both the comms-budget fence choke point and the --tp_overlap
+    dispatch. Outside models/ (ops/, core/) the same call is fine."""
+    from dtf_tpu.analysis import srclint
+
+    mdir = tmp_path / "models"
+    mdir.mkdir()
+    bad = mdir / "bad.py"
+    bad.write_text(
+        "import jax\nfrom jax import lax\n\n"
+        "def f(x):\n"
+        "    y = jax.lax.all_gather(x, 'model')\n"
+        "    return lax.psum_scatter(y, 'model')\n")
+    probs = srclint.lint_file(str(bad))
+    assert sum("core.comms" in p for p in probs) == 2, probs
+
+    ok = mdir / "ok.py"   # comms routing + noqa'd call are both exempt
+    ok.write_text(
+        "import jax\nfrom dtf_tpu.core import comms\n\n"
+        "def f(x):\n"
+        "    x = comms.all_gather(x, 'model')\n"
+        "    return jax.lax.all_gather(x, 'model')  # noqa: fence\n")
+    assert not srclint.lint_file(str(ok))
+
+    outside = tmp_path / "ops.py"  # not models/: direct lax is the point
+    outside.write_text(
+        "import jax\n\ndef f(x):\n"
+        "    return jax.lax.all_gather(x, 'seq')\n")
+    assert not srclint.lint_file(str(outside))
+
+    # the shipping models tree itself must be clean under the new rule
+    models_dir = os.path.join(ROOT, "dtf_tpu", "models")
+    probs = []
+    for f in sorted(os.listdir(models_dir)):
+        if f.endswith(".py"):
+            probs += [p for p in srclint.lint_file(
+                os.path.join(models_dir, f)) if "core.comms" in p]
+    assert not probs, probs
+
+
+def test_cli_reports_comms_delta():
+    """The analysis JSON line carries per-config collective-bytes deltas
+    vs golden (a PR's comms cost at a glance; 0 on a clean fence)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis", "--configs=mnist",
+         "--passes=hlo"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=600)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert out["comms_delta_bytes"] == {"mnist": 0}
+
+
 def test_lint_script_clean():
     proc = subprocess.run(
         ["bash", os.path.join(ROOT, "scripts", "lint.sh")],

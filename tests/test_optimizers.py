@@ -250,3 +250,46 @@ def test_decoupled_decay_promotes_recipe_l2():
     f = fl(optimizer="momentum")
     assert resolve_loss_l2(f, recipe_l2=1e-4) == pytest.approx(1e-4)
     assert f.weight_decay == -1.0
+
+
+def test_resolve_lm_loss_auto_picks_from_hbm_estimate():
+    """ISSUE 2 satellite: the LM loss path is an HBM decision (PERF.md 0c
+    — chunking costs ~9 GPT MFU points, it is a memory lever). Monolithic
+    when the [B,T,V] logits fit per device, token-chunked when they
+    don't; explicit flags win (with a warning when they force the slow
+    path on a fitting config)."""
+    from unittest import mock
+
+    from dtf_tpu.cli.flags import AUTO_LOSS_CHUNK_TOKENS, resolve_lm_loss
+
+    def lf(**kw):
+        base = dict(loss_chunk_vocab=0, loss_chunk_tokens=0,
+                    loss_pallas=False)
+        base.update(kw)
+        return SimpleNamespace(**base)
+
+    gpt = dict(seq_len=1024, vocab_size=50304)
+    # b8 s1024 V50k: ~3.3 GB logits+cotangent -> fits, monolithic
+    assert resolve_lm_loss(lf(), batch=8, **gpt) == (0, 0)
+    # b32: ~13 GB -> auto-select the token-chunked fused loss
+    assert resolve_lm_loss(lf(), batch=32, **gpt) == (
+        0, AUTO_LOSS_CHUNK_TOKENS)
+    # data/seq sharding divides the per-device logits share back under
+    # the budget
+    assert resolve_lm_loss(lf(), batch=32, mesh_shape={"data": 4},
+                           **gpt) == (0, 0)
+    # fused losses cannot ride a TP/pipe mesh: monolithic even when big
+    assert resolve_lm_loss(lf(), batch=32, mesh_shape={"model": 2},
+                           **gpt) == (0, 0)
+    assert resolve_lm_loss(lf(), batch=32, mesh_shape={"pipe": 2},
+                           **gpt) == (0, 0)
+    # explicit flags are honored either way; forcing the slow path on a
+    # fitting config warns
+    with mock.patch("absl.logging.warning") as warn:
+        assert resolve_lm_loss(lf(loss_chunk_vocab=8192), batch=8,
+                               **gpt) == (8192, 0)
+        assert warn.called
+    with mock.patch("absl.logging.warning") as warn:
+        assert resolve_lm_loss(lf(loss_chunk_tokens=4096), batch=32,
+                               **gpt) == (0, 4096)
+        assert not warn.called   # logits do NOT fit: the flag is right
