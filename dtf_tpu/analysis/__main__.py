@@ -145,6 +145,13 @@ def main(argv: list[str] | None = None) -> int:
             name: b["total"]["bytes"]
             - gb.get(name, {}).get("total", {}).get("bytes", 0)
             for name, b in sorted(budgets.items())}
+        # per-config peak temp allocation (AOT memory_analysis) — the HBM
+        # where grad accumulators and activation stashes live; the
+        # bert_accum vs bert_grad_shard rows show the --grad_shard
+        # accumulator shrink at a glance (docs/ZERO.md).
+        out["temp_bytes"] = {
+            name: b.get("memory", {}).get("temp_bytes", 0)
+            for name, b in sorted(budgets.items())}
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

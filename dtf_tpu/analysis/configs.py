@@ -160,6 +160,34 @@ def _bert_step(mesh):
     return StepView(step, state, batch)
 
 
+def _bert_accum_step(grad_shard):
+    """BASELINE config 4's machinery (grad-accum + ZeRO-1) on a dp4 x sp2
+    mesh — the ``--grad_shard`` A/B pair: ``grad_shard=False`` is the
+    replicated-accumulator control at the SAME mesh, so the golden shows
+    the all-reduce → reduce-scatter swap and the accumulator temp-bytes
+    shrink side by side (docs/ZERO.md). The model is built MESH-LESS
+    (dense attention over GSPMD-sharded tokens): ``--grad_shard`` requires
+    a pure-GSPMD loss — the shard_map'd kernels (ring/flash) pin their own
+    batch-over-data layout, which the per-shard-group vmap cannot nest."""
+
+    def build(mesh):
+        from dtf_tpu.models import bert
+
+        cfg = bert.BertConfig.tiny()
+        model, init_fn = bert.make_init(cfg, None, seq_len=32)
+        tx = optax.adamw(1e-4, weight_decay=0.01)
+        state, shardings = tr.abstract_train_state(
+            init_fn, tx, _rng(), mesh, param_rules=bert.tp_rules)
+        batch = _abstract_batch("bert", 16, seq_len=32, vocab_size=128)
+        batch_sh = batch_shardings_for(batch, mesh, P("data", "seq"))
+        step = tr.make_train_step(
+            bert.make_loss(model), tx, mesh, shardings, grad_accum=2,
+            grad_shard=grad_shard, batch_shardings=batch_sh)
+        return StepView(step, state, batch)
+
+    return build
+
+
 def _widedeep_spec(mesh):
     from dtf_tpu.models import widedeep
 
@@ -288,6 +316,10 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    _resnet_spec("imagenet"), _resnet_step("imagenet", 8)),
     AnalysisConfig("bert", MeshConfig(data=2, seq=2, model=2),
                    _bert_spec, _bert_step),
+    AnalysisConfig("bert_accum", MeshConfig(data=4, seq=2),
+                   _bert_spec, _bert_accum_step(False)),
+    AnalysisConfig("bert_grad_shard", MeshConfig(data=4, seq=2),
+                   _bert_spec, _bert_accum_step(True)),
     AnalysisConfig("widedeep", MeshConfig(data=4, model=2),
                    _widedeep_spec, _widedeep_step),
     AnalysisConfig("gpt", MeshConfig(data=2, seq=2, model=2),

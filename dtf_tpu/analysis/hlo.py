@@ -80,8 +80,22 @@ def collective_stats(hlo_text: str) -> dict:
 
 
 def comms_budget(compiled) -> dict:
-    """Budget dict for one compiled step (``lowered.compile()`` result)."""
-    return collective_stats(compiled.as_text())
+    """Budget dict for one compiled step (``lowered.compile()`` result).
+
+    Besides the per-opcode collective stats, records the step's peak temp
+    allocation (``memory_analysis().temp_size_in_bytes`` — where grad-accum
+    accumulators, activation stashes and collective staging buffers live),
+    so an accumulator-HBM regression (e.g. a ``--grad_shard`` config
+    silently falling back to the replicated f32 accumulator) fails the
+    fence in tier-1 just like an extra all-gather does.
+    """
+    budget = collective_stats(compiled.as_text())
+    try:
+        mem = compiled.memory_analysis()
+        budget["memory"] = {"temp_bytes": int(mem.temp_size_in_bytes)}
+    except Exception:  # noqa: BLE001 — backends without an allocator report
+        pass
+    return budget
 
 
 def check_budget(budget: Mapping[str, Any], golden: Mapping[str, Any],
@@ -108,6 +122,26 @@ def check_budget(budget: Mapping[str, Any], golden: Mapping[str, Any],
                 config, "hlo", "collective-bytes-drift", "error",
                 f"{op}: {got['bytes']:,} B vs {want['bytes']:,} B golden "
                 f"(count unchanged — shapes/dtypes moved)"))
+    want_mem = golden.get("memory")
+    got_mem = budget.get("memory")
+    if want_mem is not None and got_mem is None:
+        # fail CLOSED: a backend that stops reporting memory_analysis()
+        # must not silently disable the accumulator-HBM fence (and a
+        # subsequent --write-golden would silently drop the 'memory'
+        # entries) — surface it as a finding instead.
+        findings.append(Finding(
+            config, "hlo", "temp-bytes-unavailable", "error",
+            "golden pins a peak-temp budget but memory_analysis() "
+            "reported nothing on this backend — the accumulator-HBM "
+            "fence did not run"))
+    elif want_mem is not None and (
+            got_mem["temp_bytes"] != want_mem["temp_bytes"]):
+        findings.append(Finding(
+            config, "hlo", "temp-bytes-drift", "error",
+            f"peak temp allocation {got_mem['temp_bytes']:,} B vs "
+            f"{want_mem['temp_bytes']:,} B golden (accumulators / stashes "
+            f"/ staging buffers moved; regenerate with --write-golden if "
+            f"intended)"))
     return findings
 
 

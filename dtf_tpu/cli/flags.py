@@ -51,6 +51,15 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000,
     flags.DEFINE_integer("checkpoint_every", 200, "steps between saves")
     flags.DEFINE_integer("log_every", 10, "steps between metric logs")
     flags.DEFINE_integer("grad_accum", 1, "gradient-accumulation microbatches")
+    flags.DEFINE_boolean("grad_shard", False, "with --grad_accum>1: ZeRO-1 "
+                         "weight-update sharding for the accumulator — "
+                         "microbatch gradients reduce-scatter over the data "
+                         "axis into 1/N f32 shards, the optimizer update "
+                         "runs on the shard, params all-gather once per "
+                         "step (docs/ZERO.md). Needs a pure-GSPMD loss "
+                         "(dense attention; no pallas/ring/overlap "
+                         "kernels); falls back to the replicated "
+                         "accumulator with a warning otherwise")
     flags.DEFINE_float("clip_grad_norm", 0.0, "clip gradients to this global "
                        "norm before the optimizer update (0 = off)")
     flags.DEFINE_string("lr_schedule", lr_schedule, "constant | linear | "
@@ -217,6 +226,35 @@ def resolve_loss_l2(FLAGS, recipe_l2: float):
             "--weight_decay to the recipe's %g (decoupled decay). Pass "
             "--weight_decay explicitly to override.", name, recipe_l2)
     return 0.0
+
+
+def resolve_grad_shard(FLAGS, mesh, *, blockers=()):
+    """``--grad_shard`` viability — the safe-fallback gate (docs/ZERO.md).
+
+    The sharded accumulator needs a real data axis, real accumulation, and
+    a pure-GSPMD loss: the shard_map'd kernels (ring/zigzag/halo
+    attention, flash, the Pallas fused CE, the collective-matmul overlap,
+    pipelined stages) pin their own batch-over-data layouts, which the
+    per-shard-group vmap cannot nest inside — those would fail at trace
+    time deep inside a kernel. Launchers pass the kernel facts they know
+    as ``blockers``; this returns the effective setting, WARNING on
+    fallback instead of crashing.
+    """
+    from absl import logging as absl_logging
+
+    if not getattr(FLAGS, "grad_shard", False):
+        return False
+    reasons = list(blockers)
+    if getattr(FLAGS, "grad_accum", 1) <= 1:
+        reasons.append("--grad_accum<=1 (no accumulator to shard)")
+    if mesh.shape.get("data", 1) <= 1:
+        reasons.append("data axis is 1 (nothing to reduce-scatter over)")
+    if reasons:
+        absl_logging.warning(
+            "--grad_shard falls back to the replicated accumulator: %s",
+            "; ".join(reasons))
+        return False
+    return True
 
 
 #: v5e HBM per chip; the loss-path picker budgets against a fraction of it

@@ -148,9 +148,84 @@ def host_local_to_global(local_batch: PyTree, mesh: Mesh,
 
 
 def global_norm(tree: PyTree) -> jax.Array:
-    """L2 norm over a pytree (for grad-norm logging/clipping)."""
+    """L2 norm over a pytree (for grad-norm logging/clipping).
+
+    Works on shard-constrained leaves too: under jit GSPMD lowers each
+    ``vdot`` to a local square-sum plus a scalar psum over the sharded
+    axes, so the norm of a reduce-scattered gradient tree (``--grad_shard``)
+    comes from per-shard partial norms without re-gathering the shards.
+    """
     leaves = jax.tree.leaves(tree)
     return jnp.sqrt(sum(jnp.vdot(x, x).real for x in leaves))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1 weight-update sharding (the --grad_shard choke point).
+# ---------------------------------------------------------------------------
+
+def _pin_tree(tree: PyTree, mesh: Mesh, specs: PyTree) -> PyTree:
+    return jax.tree.map(
+        lambda x, s: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, s)), tree, specs)
+
+
+def shard_grads(grads: PyTree, mesh: Mesh, shard_specs: PyTree) -> PyTree:
+    """Pin a gradient/accumulator/update pytree to its ZeRO-1 shard layout
+    (``sharding.zero1_param_shard_specs``) — every replica holds only its
+    1/N slice, and the optimizer math that consumes the tree partitions to
+    1/N of the elementwise FLOPs (weight-update sharding, Xu et al.,
+    PAPERS.md; docs/ZERO.md)."""
+    return _pin_tree(grads, mesh, shard_specs)
+
+
+def grad_reduce_scatter(stacked: PyTree, mesh: Mesh, param_specs: PyTree,
+                        shard_specs: PyTree, *, axis: str = "data") -> PyTree:
+    """Reduce-scatter stacked per-shard partial gradients into ZeRO-1 shards
+    — THE swap of weight-update sharding (Xu et al., PAPERS.md), and the
+    ``--grad_shard`` choke point like ``tp_dense`` is for TP overlap.
+
+    ``stacked``: a gradient tree whose leaves carry a leading
+    ``[n_data, ...param dims]`` axis sharded over ``axis`` — slot k holds
+    data-shard k's gradient over ITS OWN batch rows only (from the
+    per-shard-group vmap in ``make_train_step``), so each replica owns its
+    partial and nothing has been reduced yet. Each leaf then rides ONE
+    ``psum_scatter`` over ``axis``: the cross-replica sum and the 1/N
+    scatter happen in the same collective, moving half the bytes of the
+    all-reduce it replaces and returning the full-shaped leaf laid out per
+    ``shard_specs`` (its ``zero1_param_shard_specs`` layout). Leaves with
+    no data-divisible dim (scalars, tiny biases) fall back per-leaf to an
+    explicit ``psum`` — correct, just unscattered.
+
+    GSPMD cannot be left to do this here: the jit partitioner resolves a
+    partial sum feeding a sharded consumer as all-reduce + dynamic-slice
+    (full bytes, replicated transient), so the collective is issued
+    explicitly via a per-leaf ``shard_map``.
+    """
+    def leaf(g, pspec, sspec):
+        ps = tuple(pspec) + (None,) * (g.ndim - 1 - len(pspec))
+        ss = tuple(sspec) + (None,) * (g.ndim - 1 - len(sspec))
+        d = next((i for i, (a, b) in enumerate(zip(ps, ss)) if a != b), None)
+        g = jax.lax.with_sharding_constraint(
+            g, NamedSharding(mesh, P(axis, *ps)))
+        if d is None:
+            body = lambda x: jax.lax.psum(x[0], axis)          # noqa: E731
+            out = P(*ps)
+        else:
+            body = lambda x: jax.lax.psum_scatter(             # noqa: E731
+                x[0], axis, scatter_dimension=d, tiled=True)
+            out = P(*ss)
+        return jax.shard_map(body, mesh=mesh, in_specs=P(axis, *ps),
+                             out_specs=out)(g)
+
+    return jax.tree.map(leaf, stacked, param_specs, shard_specs)
+
+
+def unshard_params(params: PyTree, mesh: Mesh, param_specs: PyTree) -> PyTree:
+    """Pin updated params back to their serving layout — the one
+    per-step ALL-GATHER that closes weight-update sharding: the optimizer
+    ran on 1/N-sized shards, and the next forward needs each param back
+    in its rulebook placement."""
+    return _pin_tree(params, mesh, param_specs)
 
 
 # ---------------------------------------------------------------------------

@@ -156,6 +156,27 @@ def _zero1_leaf_spec(param_spec: P, shape: tuple[int, ...], data_size: int,
     return P(*spec)
 
 
+def zero1_param_shard_specs(params: PyTree, param_specs: PyTree, mesh: Mesh,
+                            axis: str = "data") -> PyTree:
+    """Per-param ZeRO-1 *shard* layouts, paired with :func:`zero1_opt_specs`.
+
+    Each param's own spec extended by an ``axis`` shard on its first free
+    divisible dim — exactly the placement :func:`zero1_opt_specs` gives the
+    param-shaped optimizer moments, so a gradient accumulated in this layout
+    lines up shard-for-shard with the optimizer state it feeds. This is the
+    layout ``make_train_step(grad_shard=True)`` reduce-scatters microbatch
+    gradients into and runs the optimizer update in (docs/ZERO.md). Leaves
+    with no free divisible dim keep the param's own spec — the safe per-leaf
+    fallback to the replicated accumulator.
+    """
+    data_size = mesh.shape.get(axis, 1)
+    return jax.tree.map(
+        lambda p, spec: _zero1_leaf_spec(
+            spec, tuple(p.shape), data_size, axis,
+            param_shape=tuple(p.shape)),
+        params, param_specs)
+
+
 def zero1_opt_specs(tx: optax.GradientTransformation, params: PyTree,
                     param_specs: PyTree, mesh: Mesh,
                     axis: str = "data") -> PyTree:

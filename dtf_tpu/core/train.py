@@ -33,7 +33,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtf_tpu import _jax_compat as _compat
 from dtf_tpu.core import sharding as shd
-from dtf_tpu.core.comms import batch_sharding, global_norm
+from dtf_tpu.core.comms import (batch_sharding, global_norm,
+                                grad_reduce_scatter, shard_grads,
+                                unshard_params)
 
 PyTree = Any
 #: loss_fn(params, extra, batch, rng) -> (loss, LossAux)
@@ -186,6 +188,7 @@ def make_train_step(
     shardings: TrainState,
     *,
     grad_accum: int = 1,
+    grad_shard: bool = False,
     compute_dtype: jnp.dtype | None = None,
     log_grad_norm: bool = True,
     donate: bool = True,
@@ -204,6 +207,27 @@ def make_train_step(
     default, giving the plain mean; count-normalized losses return their
     valid count so the result equals the full-batch gradient exactly).
     Loss and metrics combine with the same weights.
+
+    ``grad_shard`` (with ``grad_accum > 1`` and a data axis > 1): ZeRO-1
+    weight-update sharding for the accumulator (docs/ZERO.md). Each
+    microbatch is split into its per-data-shard row groups (a vmapped
+    loss call whose per-group gradients contract only over local rows, so
+    nothing is reduced prematurely), the weighted per-group gradients are
+    reduce-scattered over ``data`` into a 1/N-sized f32 shard accumulator
+    inside the scan (the ``comms.grad_reduce_scatter`` choke point — half
+    the bytes of the full all-reduce the replicated path issues per
+    microbatch, overlapping the next microbatch's compute), the optimizer
+    update runs on the gradient/param shard against the already-sharded
+    ZeRO-1 optimizer state, and updated params are all-gathered back to
+    their rulebook layout once per step (``comms.unshard_params``).
+    Numerics are exact: the Σwᵢgᵢ/Σwᵢ weighting composes over the finer
+    shard×microbatch grid (per-group count weights combine to the same
+    full-batch gradient — bitwise on integer data); only the per-group
+    dropout rng assignment differs (``fold_in(mb_rng, group)`` instead of
+    one global mask per microbatch). Falls back to the replicated
+    accumulator when ``data == 1``, when mutable collections are in play
+    (``extra`` leaves cannot thread through shard-stacked loss calls),
+    and per-leaf for params with no data-divisible dim.
     """
 
     def grads_of(params, extra, micro, rng):
@@ -215,8 +239,13 @@ def make_train_step(
             params, extra, micro, rng)
         return loss, aux, grads
 
+    param_specs = jax.tree.map(lambda s: s.spec, shardings.params)
+
     def step_fn(state: TrainState, batch: PyTree) -> tuple[TrainState, dict]:
         rng = jax.random.fold_in(state.rng, state.step)
+        # set on the sharded-accumulator path; gates the shard-domain
+        # optimizer update + the closing param all-gather below.
+        shard_specs = None
 
         if grad_accum == 1:
             loss, aux, grads = grads_of(state.params, state.extra, batch, rng)
@@ -224,6 +253,15 @@ def make_train_step(
             extra = aux.extra
         else:
             data_size = mesh.shape.get("data", 1)
+            # sharded-accumulation viability: a real data axis, and no
+            # mutable collections — the per-shard-group loss calls each
+            # produce their own `extra`, which cannot be threaded back
+            # into one carry. The replicated path below stays bit-exact
+            # with today's behavior whenever this is False.
+            if (grad_shard and data_size > 1
+                    and not jax.tree.leaves(state.extra)):
+                shard_specs = shd.zero1_param_shard_specs(
+                    state.params, param_specs, mesh)
 
             def to_micro(x, sh=None):
                 if x.shape[0] % grad_accum or (
@@ -237,12 +275,21 @@ def make_train_step(
                 # the leaf's batch sharding (e.g. P('data','seq') token ids
                 # stay seq-sharded — hardcoding None here would all-gather
                 # the sequence and defeat context parallelism).
-                y = x.reshape(
-                    (grad_accum, x.shape[0] // grad_accum) + x.shape[1:])
                 spec = tuple(sh.spec) if sh is not None else ("data",)
                 spec = spec + (None,) * (x.ndim - len(spec))
+                m = x.shape[0] // grad_accum
+                if shard_specs is not None:
+                    # split each microbatch into its per-data-shard row
+                    # groups: [accum, n_data, rows/shard, ...], group axis
+                    # on `data` so slot k IS shard k's local rows.
+                    y = x.reshape(
+                        (grad_accum, data_size, m // data_size) + x.shape[1:])
+                    full = P(None, "data", None, *spec[1:])
+                else:
+                    y = x.reshape((grad_accum, m) + x.shape[1:])
+                    full = P(None, *spec)
                 return jax.lax.with_sharding_constraint(
-                    y, NamedSharding(mesh, P(None, *spec)))
+                    y, NamedSharding(mesh, full))
 
             if batch_shardings is None:
                 micro = jax.tree.map(to_micro, batch)
@@ -252,6 +299,43 @@ def make_train_step(
             def body(carry, mb):
                 acc, w_sum, extra, i = carry
                 mb_rng = jax.random.fold_in(rng, i)
+                if shard_specs is not None:
+                    # per-shard-group gradients: each vmap slot contracts
+                    # only over its own (local) rows, so slot k holds
+                    # shard k's UNREDUCED partial — the value the explicit
+                    # reduce-scatter below sums and scatters in one
+                    # collective. Σwᵢgᵢ/Σwᵢ runs over the finer
+                    # group×microbatch grid, which combines to exactly the
+                    # full-batch gradient (weights are per-group counts).
+                    loss, aux, grads = jax.vmap(
+                        lambda mb_k, k: grads_of(
+                            state.params, extra, mb_k,
+                            jax.random.fold_in(mb_rng, k)))(
+                        mb, jnp.arange(data_size))
+                    w = jnp.broadcast_to(
+                        jnp.asarray(aux.weight, jnp.float32), (data_size,))
+                    # a group whose weight is 0 (e.g. no masked MLM
+                    # positions among its rows) may carry a 0/0 loss and
+                    # NaN gradients from the loss's own count
+                    # normalization; its Σwᵢgᵢ/Σwᵢ contribution is exactly
+                    # zero either way, so select — don't multiply — it out
+                    # (0·NaN would poison the accumulator).
+                    def wmul(v):
+                        wb = w[(...,) + (None,) * (v.ndim - 1)]
+                        return jnp.where(wb > 0, v.astype(jnp.float32) * wb,
+                                         0.0)
+
+                    acc = jax.tree.map(
+                        lambda a, r: a + r,
+                        acc, grad_reduce_scatter(
+                            jax.tree.map(wmul, grads), mesh, param_specs,
+                            shard_specs))
+                    # emit PRE-weighted per-microbatch sums; the post-scan
+                    # combine divides the stacked sums by w_sum directly.
+                    return ((acc, w_sum + w.sum(), extra, i + 1),
+                            (wmul(loss).sum(), w.sum(),
+                             jax.tree.map(lambda m: wmul(m).sum(),
+                                          aux.metrics)))
                 loss, aux, grads = grads_of(state.params, extra, mb, mb_rng)
                 w = jnp.asarray(aux.weight, jnp.float32)
                 acc = jax.tree.map(
@@ -261,6 +345,8 @@ def make_train_step(
 
             acc0 = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            if shard_specs is not None:
+                acc0 = shard_grads(acc0, mesh, shard_specs)
             (grads, w_sum, extra, _), (losses, ws, metric_seq) = jax.lax.scan(
                 body,
                 (acc0, jnp.zeros((), jnp.float32), state.extra,
@@ -268,12 +354,25 @@ def make_train_step(
                 micro)
             grads = jax.tree.map(
                 lambda g, p: (g / w_sum).astype(p.dtype), grads, state.params)
+            if shard_specs is not None:
+                grads = shard_grads(grads, mesh, shard_specs)
             loss = losses.sum() / w_sum
+            # sharded path stacks PRE-weighted metric sums (see body);
+            # replicated path stacks raw per-microbatch means.
             metrics = jax.tree.map(
-                lambda m: (m * ws).sum() / w_sum, dict(metric_seq))
+                lambda m: (m if shard_specs is not None
+                           else m * ws).sum() / w_sum, dict(metric_seq))
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        if shard_specs is not None:
+            # keep the update math in the shard domain (1/N of the
+            # elementwise optimizer FLOPs per replica, against the
+            # already-sharded ZeRO-1 moments) ...
+            updates = shard_grads(updates, mesh, shard_specs)
         new_params = optax.apply_updates(state.params, updates)
+        if shard_specs is not None:
+            # ... and close with the ONE param all-gather per step.
+            new_params = unshard_params(new_params, mesh, param_specs)
         metrics["loss"] = loss
         if log_grad_norm:
             metrics["grad_norm"] = global_norm(grads)

@@ -13,6 +13,7 @@ manager either way).
 
 from __future__ import annotations
 
+import itertools
 import logging
 from typing import Any, Callable, Iterable, Sequence
 
@@ -69,6 +70,16 @@ class Trainer:
 
         for h in self.hooks:
             h.begin(state)
+        # ONE device sync, at the resume point: `state.step` is a device
+        # array whose int() blocks on the previous step's completion, so
+        # reading it every iteration (as this loop once did) serializes
+        # dispatch against compute and defeats the prefetch double-buffer.
+        # After this read the counter lives on the host — train_step
+        # advances the device counter by exactly 1 per call (the
+        # make_train_step contract), so the two never diverge; hooks that
+        # want device values (metrics, checkpoints) still block only when
+        # THEY materialize them, at their own every_n cadence.
+        step = int(state.step)
         # Bound the source to exactly the steps this call can run, so the
         # prefetch lookahead can never pull batches past max_steps out of a
         # (possibly shared) iterator — including the already-done resume
@@ -77,15 +88,11 @@ class Trainer:
         # that lookahead is inherent to prefetching.
         src = batches
         if max_steps is not None:
-            import itertools
-
-            src = itertools.islice(
-                batches, max(max_steps - int(state.step), 0))
+            src = itertools.islice(batches, max(max_steps - step, 0))
         staged = prefetch_to_device(src, self.place_batch,
                                     max(self.prefetch, 1))
         try:
             for batch in staged:
-                step = int(state.step)
                 if max_steps is not None and step >= max_steps:
                     break
                 for h in self.hooks:

@@ -63,6 +63,24 @@ class BertConfig:
                           intermediate=64, max_positions=64, dropout=0.0, **kw)
 
 
+def effective_attn_impl(impl: str, seq_sharded: bool) -> str:
+    """Resolve the attention dispatch exactly as :class:`SelfAttention`
+    does: a seq axis > 1 ALWAYS routes to the seq-sharded ring (the
+    ``--attn_impl`` flag only controls the non-seq-sharded backend);
+    otherwise ``auto`` means flash on TPU, dense elsewhere.
+
+    THE single source of truth for the dispatch: launchers call this to
+    decide ``--grad_shard`` viability (everything but ``dense`` runs in a
+    shard_map the per-shard-group vmap cannot nest — docs/ZERO.md), so a
+    dispatch change here cannot drift from the blocker logic.
+    """
+    if seq_sharded:
+        return "ring"
+    if impl != "auto":
+        return impl
+    return "flash" if jax.default_backend() == "tpu" else "dense"
+
+
 #: Megatron-style TP placement over the `model` mesh axis (SURVEY.md §2c TP).
 tp_rules = [
     (r"token_embed/embedding", P("model", None)),       # vocab-sharded rows
@@ -97,16 +115,16 @@ class SelfAttention(nn.Module):
                              d_head).transpose(0, 2, 1, 3)
 
         q, k, v = (split(dense(n)(x)) for n in ("query", "key", "value"))
-        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
+        seq_sharded = (self.mesh is not None
+                       and self.mesh.shape.get("seq", 1) > 1)
+        impl = effective_attn_impl(cfg.attn_impl, seq_sharded)
+        if seq_sharded:
             # context parallelism: ring attention over the seq axis; the pad
             # mask rides the ring with K/V so padded keys are excluded
             # exactly as in the dense path.
             out = att.ring_attention_sharded(q, k, v, self.mesh,
                                              kv_mask=pad_mask)
         else:
-            impl = cfg.attn_impl
-            if impl == "auto":
-                impl = "flash" if jax.default_backend() == "tpu" else "dense"
             if impl == "flash":
                 # fused kernel with the padding mask riding as a -inf bias
                 # row; batch over data, heads over model, seq whole/shard.

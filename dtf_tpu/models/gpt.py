@@ -170,6 +170,22 @@ class GPTConfig:
                          d_ff=64, **kw)
 
 
+def effective_attn_impl(impl: str, seq_sharded: bool) -> str:
+    """Resolve ``attn_impl='auto'`` exactly as the attention block
+    dispatches it (ring when seq-sharded, flash on TPU, dense otherwise).
+
+    THE single source of truth for the dispatch: launchers call this to
+    decide ``--grad_shard`` viability (everything but ``dense`` runs in a
+    shard_map the per-shard-group vmap cannot nest — docs/ZERO.md), so a
+    dispatch change here cannot drift from the blocker logic.
+    """
+    if impl != "auto":
+        return impl
+    if seq_sharded:
+        return "ring"
+    return "flash" if jax.default_backend() == "tpu" else "dense"
+
+
 #: Megatron TP placement over the `model` mesh axis.
 tp_rules = [
     (r"token_embed/embedding", P("model", None)),
@@ -436,16 +452,9 @@ class CausalSelfAttention(nn.Module):
             out = out.astype(cfg.dtype).reshape(b, 1, cfg.d_model)
             return out_dense()(out)
 
-        impl = cfg.attn_impl
         seq_sharded = (self.mesh is not None
                        and self.mesh.shape.get("seq", 1) > 1)
-        if impl == "auto":
-            if seq_sharded:
-                impl = "ring"
-            elif jax.default_backend() == "tpu":
-                impl = "flash"
-            else:
-                impl = "dense"
+        impl = effective_attn_impl(cfg.attn_impl, seq_sharded)
 
         if self.manual_seq:
             # t is the LOCAL shard length; global positions via axis index

@@ -62,6 +62,11 @@ def child():
         seq = int(os.environ.get("DTF_LM_SEQ", "64" if tiny else "512"))
         accum = int(os.environ.get("DTF_LM_ACCUM", "2" if tiny else "4"))
         cfg = bert.BertConfig.tiny() if tiny else bert.BertConfig.base()
+        attn = os.environ.get("DTF_LM_ATTN", "")
+        if attn:  # grad-shard A/B pins dense (flash = shard_map kernel)
+            import dataclasses
+
+            cfg = dataclasses.replace(cfg, attn_impl=attn)
         model, init_fn = bert.make_init(cfg, None, seq_len=seq)
         tx = optax.adamw(1e-4, weight_decay=0.01)
         # config 4's machinery: ZeRO-1 + grad accum
@@ -70,17 +75,27 @@ def child():
             param_rules=bert.tp_rules, zero1=True)
         lchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK", "0"))
         lgather = int(os.environ.get("DTF_LM_MLM_GATHER", "0"))
+        gshard = os.environ.get("DTF_LM_GRAD_SHARD") == "1"
+        # record the EFFECTIVE setting: on a 1-chip tunnel (data axis = 1)
+        # make_train_step silently runs the replicated fallback, and a row
+        # claiming grad_shard=true with identical timings would read as
+        # "the sharded accumulator is perf-neutral".
+        data_size = dict(mesh.shape).get("data", 1)
         loss_fn = bert.make_loss(model, loss_chunk=lchunk,
                                  mlm_gather=lgather)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
-                                  grad_accum=accum, log_grad_norm=False)
+                                  grad_accum=accum, grad_shard=gshard,
+                                  log_grad_norm=False)
         data = shard_batch(
             SyntheticData("bert", batch, seed=0, seq_len=seq,
                           vocab_size=cfg.vocab_size).batch(0), mesh)
         n_params = _count_params(state.params)
         row.update(batch=batch, seq=seq, grad_accum=accum,
                    n_params=int(n_params), zero1=True, loss_chunk=lchunk,
-                   mlm_gather=lgather)
+                   mlm_gather=lgather, mesh_data=data_size,
+                   grad_shard=gshard and data_size > 1 and accum > 1,
+                   grad_shard_requested=gshard,
+                   attn=attn or "auto")
         unit_scale = batch * seq  # tokens per step
     elif which == "gpt":
         from dtf_tpu.data.synthetic import SyntheticData
@@ -109,6 +124,9 @@ def child():
             row["n_chips"] = mesh.devices.size
         if overlap:
             cfg = dataclasses.replace(cfg, tp_overlap=True)
+        attn = os.environ.get("DTF_LM_ATTN", "")
+        if attn:  # grad-shard A/B pins dense (flash = shard_map kernel)
+            cfg = dataclasses.replace(cfg, attn_impl=attn)
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=seq)
         tx = optax.adamw(1e-4, weight_decay=0.01)
         state, shardings = tr.create_train_state(
@@ -117,19 +135,27 @@ def child():
         lchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK", "0"))
         tchunk = int(os.environ.get("DTF_LM_LOSS_CHUNK_T", "0"))
         lpallas = os.environ.get("DTF_LM_LOSS_PALLAS") == "1"
+        accum = int(os.environ.get("DTF_LM_ACCUM", "1"))
+        gshard = os.environ.get("DTF_LM_GRAD_SHARD") == "1"
+        # effective setting, not the request (see the bert branch note)
+        data_size = dict(mesh.shape).get("data", 1)
         loss_fn = gpt.make_loss(model, loss_chunk=lchunk,
                                 loss_chunk_tokens=tchunk,
                                 loss_pallas=lpallas)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
+                                  grad_accum=accum, grad_shard=gshard,
                                   log_grad_norm=False)
         data = shard_batch(
             SyntheticData("gpt", batch, seed=0, seq_len=seq,
                           vocab_size=cfg.vocab_size).batch(0), mesh)
-        row.update(batch=batch, seq=seq, attn="flash(auto)",
+        row.update(batch=batch, seq=seq, attn=attn or "flash(auto)",
                    gpt_size="tiny" if tiny else size,
                    n_params=int(_count_params(state.params)), zero1=True,
                    loss_chunk=lchunk, loss_chunk_tokens=tchunk,
-                   loss_pallas=lpallas, mesh_model=tp, tp_overlap=overlap)
+                   loss_pallas=lpallas, mesh_model=tp, tp_overlap=overlap,
+                   grad_accum=accum, mesh_data=data_size,
+                   grad_shard=gshard and data_size > 1 and accum > 1,
+                   grad_shard_requested=gshard)
         unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
@@ -345,6 +371,26 @@ def main():
              "DTF_LM_MESH_MODEL": "2", "DTF_LM_TP_OVERLAP": "1"},
         ]
         artifact = os.path.join(ROOT, "BENCH_LM_TP_OVERLAP.json")
+    elif "--sweep-grad-shard" in sys.argv:
+        # ISSUE 3 A/B: sharded vs replicated grad accumulator at identical
+        # configs — BERT-base accum4 (the BASELINE config-4 machinery) and
+        # GPT-2-small accum4. Both sides pin DENSE attention: flash is a
+        # shard_map kernel the per-shard-group vmap cannot nest
+        # (docs/ZERO.md), and an A/B must not conflate the attention
+        # backend with the grad-path delta. On a 1-chip tunnel (data=1)
+        # the sharded rows record the documented replicated fallback; the
+        # pair banks its real delta the first time a multi-chip pool
+        # answers.
+        jobs = [
+            {"DTF_LM_WHICH": "bert", "DTF_LM_ATTN": "dense"},
+            {"DTF_LM_WHICH": "bert", "DTF_LM_ATTN": "dense",
+             "DTF_LM_GRAD_SHARD": "1"},
+            {"DTF_LM_WHICH": "gpt", "DTF_LM_ATTN": "dense",
+             "DTF_LM_ACCUM": "4"},
+            {"DTF_LM_WHICH": "gpt", "DTF_LM_ATTN": "dense",
+             "DTF_LM_ACCUM": "4", "DTF_LM_GRAD_SHARD": "1"},
+        ]
+        artifact = os.path.join(ROOT, "BENCH_LM_GRAD_SHARD.json")
     elif "--phases-gpt" in sys.argv:
         # fwd / fwd+bwd / full-step decomposition: pins a low MFU on fwd
         # math, bwd math, or the optimizer tail by subtraction.

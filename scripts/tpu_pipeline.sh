@@ -22,6 +22,7 @@ python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --sweep-bert
 python scripts/bench_lm.py --sweep-tp-overlap
+python scripts/bench_lm.py --sweep-grad-shard
 python scripts/bench_attention.py tpu --sweep-blocks-bwd
 python scripts/bench_decode.py
 python scripts/bench_cost_table.py

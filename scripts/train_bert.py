@@ -118,10 +118,22 @@ def main(argv):
             "gather indexes across the whole sequence, which would force "
             "GSPMD to all-gather the seq-sharded hidden states — exactly "
             "the cost seq sharding exists to avoid")
+    # --grad_shard viability: everything but dense attention runs in a
+    # shard_map the per-shard-group vmap cannot nest (docs/ZERO.md);
+    # the model's own dispatch helper keeps this in lockstep.
+    eff_attn = bert.effective_attn_impl(FLAGS.attn_impl, sp)
+    blockers = []
+    if eff_attn != "dense":
+        blockers.append(f"attention impl {eff_attn!r} runs in shard_map"
+                        + ("" if sp else " (use --attn_impl=dense)"))
+    if FLAGS.tp_overlap and mesh.shape.get("model", 1) > 1:
+        blockers.append("--tp_overlap collective matmuls run in shard_map")
+    grad_shard = dflags.resolve_grad_shard(FLAGS, mesh, blockers=blockers)
     step = tr.make_train_step(
         bert.make_loss(model, loss_chunk=FLAGS.loss_chunk_vocab,
                        mlm_gather=FLAGS.mlm_gather), tx, mesh,
-        shardings, grad_accum=FLAGS.grad_accum, **kwargs)
+        shardings, grad_accum=FLAGS.grad_accum, grad_shard=grad_shard,
+        **kwargs)
 
     from dtf_tpu.core.comms import shard_batch
 

@@ -268,11 +268,41 @@ def main(argv):
         kwargs["batch_shardings"] = batch_shardings_for(
             data.batch(0), mesh, spec)
     if grads_fn is not None:
+        if FLAGS.grad_shard:
+            absl_logging.warning(
+                "--grad_shard has no effect with --pipe_schedule=1f1b "
+                "(microbatching lives inside the fused schedule)")
         step = tr.make_train_step_from_grads(grads_fn, tx, mesh, shardings,
                                              **kwargs)
     else:
+        # --grad_shard viability: the sharded accumulator needs a
+        # pure-GSPMD loss — the shard_map kernels (ring/zigzag/halo/flash
+        # attention, Pallas CE, collective-matmul overlap, pipeline
+        # stages) pin their own batch-over-data layouts the
+        # per-shard-group vmap cannot nest (docs/ZERO.md).
+        eff_attn = gpt.effective_attn_impl(FLAGS.attn_impl, sp)
+        blockers = []
+        if eff_attn != "dense":
+            # covers the seq-sharded ring/zigzag/halo family and flash;
+            # explicit dense composes even windowed + seq-sharded (the
+            # model's dense path is pure GSPMD).
+            blockers.append(f"attention impl {eff_attn!r} runs in "
+                            "shard_map (use --attn_impl=dense)")
+        if FLAGS.loss_pallas:
+            blockers.append("--loss_pallas fused CE runs in shard_map")
+        if FLAGS.tp_overlap and mesh.shape.get("model", 1) > 1:
+            blockers.append("--tp_overlap collective matmuls run in "
+                            "shard_map")
+        if pipelined:
+            blockers.append("pipelined stages run in shard_map")
+        if FLAGS.moe_every:
+            blockers.append("MoE aux losses ride mutable collections, "
+                            "which shard-stacked loss calls cannot thread")
+        grad_shard = dflags.resolve_grad_shard(FLAGS, mesh,
+                                               blockers=blockers)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
-                                  grad_accum=FLAGS.grad_accum, **kwargs)
+                                  grad_accum=FLAGS.grad_accum,
+                                  grad_shard=grad_shard, **kwargs)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),

@@ -233,7 +233,11 @@ def test_shipping_config_jaxpr_clean(name):
 
 
 GOLDEN = runner.golden_path()
-FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert"]
+# bert_accum/bert_grad_shard ride the fast tier so the --grad_shard
+# reduce-scatter swap AND its accumulator temp-bytes fence fail in tier-1
+# (ISSUE 3; docs/ZERO.md).
+FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert", "bert_accum",
+                       "bert_grad_shard"]
 
 
 @pytest.mark.parametrize("name", FAST_BUDGET_CONFIGS)

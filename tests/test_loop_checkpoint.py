@@ -213,6 +213,75 @@ def test_profiler_hook_writes_xplane_trace(mesh8, tmp_path):
     assert traces, f"no XPlane trace written under {logdir}"
 
 
+def test_fit_steady_state_has_no_per_step_readback():
+    """The sync-free host loop (ISSUE 3): `int(state.step)` is a blocking
+    device readback, and the loop once issued it EVERY iteration —
+    serializing dispatch against compute and defeating the prefetch
+    double-buffer. Steady-state iterations must now enqueue without any
+    readback; the counter syncs O(1) times per fit (the resume point),
+    independent of step count. Proven with a counter-instrumented fake
+    step whose `.step` records every int() cast."""
+    casts = []
+
+    class FakeStep:
+        def __init__(self, v):
+            self.v = v
+
+        def __int__(self):
+            casts.append(1)
+            return self.v
+
+    class FakeState:
+        def __init__(self, v):
+            self.step = FakeStep(v)
+
+    def fake_train_step(state, batch):
+        return FakeState(state.step.v + 1), {}
+
+    def run(n, start=0, max_steps=None):
+        casts.clear()
+        t = Trainer(fake_train_step, mesh=None, place_batch=lambda b: b,
+                    prefetch=2)
+        out = t.fit(FakeState(start), iter(range(1000)),
+                    max_steps=n if max_steps is None else max_steps)
+        return len(casts), out
+
+    c3, out3 = run(3)
+    c30, out30 = run(30)
+    assert out3.step.v == 3 and out30.step.v == 30
+    assert c3 == c30, (c3, c30)          # O(1), not O(steps)
+    assert c30 <= 2
+    # resume semantics unchanged: starting past max_steps is a no-op
+    casts.clear()
+    t = Trainer(fake_train_step, mesh=None, place_batch=lambda b: b)
+    done = t.fit(FakeState(7), iter(range(1000)), max_steps=5)
+    assert done.step.v == 7
+
+
+def test_fit_hooks_see_host_counter_and_metrics_still_flow(mesh8):
+    """Hooks keep their exact step numbering under the host-side counter
+    (before_step gets the pre-step index, after_step the post-step one),
+    and metric materialization stays a hook-side choice."""
+    seen = []
+
+    class Probe(StopAtStepHook):
+        def before_step(self, step):
+            seen.append(("before", step))
+            super().before_step(step)
+
+        def after_step(self, step, state, metrics):
+            seen.append(("after", step, float(metrics["loss"])))
+            super().after_step(step, state, metrics)
+
+    state, step = build(mesh8)
+    Trainer(step, mesh8, hooks=[Probe(3)]).fit(state, batches(10))
+    assert [s for s in seen if s[0] == "before"] == [
+        ("before", 0), ("before", 1), ("before", 2)]
+    assert [(k, s) for k, s, *_ in seen if k == "after"] == [
+        ("after", 1), ("after", 2), ("after", 3)]
+    assert all(np.isfinite(s[2]) for s in seen if s[0] == "after")
+
+
 def test_logging_hook_reports_schedule_lr(mesh8):
     """LoggingHook(lr_schedule=...) surfaces the CURRENT schedule value
     (and a plain float passes through) next to the step metrics."""
