@@ -250,6 +250,23 @@ def _gpt_step(**cfg_kw):
     return build
 
 
+def _gpt_serve_step(mesh):
+    """The serving engine's ``decode_all`` program (dtf_tpu/serve) as a
+    step view: state = the TP-sharded params, batch = the slot-batched
+    engine state (KV cache P('data','model') — slots over data shards,
+    heads over TP shards). The fence pins the decode graph's collectives
+    exactly as ``DecodeEngine`` AOT-compiles them, so a resharding slipped
+    into the serving hot path (e.g. a cache spec change making GSPMD
+    all-gather every slot's K/V per token) fails tier-1 before a chip
+    ever serves it."""
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve.engine import decode_step_view
+
+    step, abs_params, abs_state = decode_step_view(
+        gpt.GPTConfig.tiny(), n_slots=8, max_len=64, mesh=mesh)
+    return StepView(step, abs_params, abs_state)
+
+
 def _gpt_pipe_spec(mesh):
     from dtf_tpu.models import gpt, gpt_pipe
 
@@ -335,6 +352,11 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    allow_dead=(r"w_(in|out)$",)),
     AnalysisConfig("gpt_moe", MeshConfig(data=4, expert=2),
                    _gpt_spec(moe_every=2), _gpt_step(moe_every=2)),
+    AnalysisConfig("gpt_serve", MeshConfig(data=4, model=2),
+                   _gpt_spec(), _gpt_serve_step,
+                   # decode-mode config: the step is the serving engine's
+                   # decode_all, not a train step (dtf_tpu/serve).
+                   allow_dead=(r"w_(in|out)$",)),
     AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),
                    _gpt_pipe_spec, _gpt_pipe_step("gpipe"),
                    # embed/head ride ZeRO-1 over data, not the pipe axis

@@ -10,6 +10,8 @@ the process that owns it, asynchronously — no gather, no traffic spike.
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 from typing import Any
 
@@ -17,6 +19,47 @@ import jax
 import orbax.checkpoint as ocp
 
 PyTree = Any
+
+log = logging.getLogger("dtf_tpu")
+
+#: the model-config manifest written next to the Orbax step dirs by the
+#: training launchers (currently train_gpt.py) and auto-loaded by the
+#: serving entrypoints — see save_model_config / load_model_config.
+MODEL_CONFIG_BASENAME = "model_config.json"
+
+
+def save_model_config(directory: str | os.PathLike, config: dict) -> None:
+    """Write the architecture manifest next to the checkpoint (chief only).
+
+    The serving entrypoints (``generate_gpt.py`` / ``serve_gpt.py``) decode
+    with whatever config they are handed; before this manifest existed they
+    trusted hand-matched ``--size``-style flags, and a mismatch silently
+    garbled decode (wrong head count reads the cache at the wrong stride —
+    no shape error). Training launchers call this once at startup; values
+    must be JSON-serializable.
+    """
+    if jax.process_index() != 0:
+        return
+    directory = os.fspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, MODEL_CONFIG_BASENAME)
+    with open(path, "w") as f:
+        json.dump(config, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_model_config(directory: str | os.PathLike) -> dict | None:
+    """The manifest saved by :func:`save_model_config`, or None (old
+    checkpoints / corrupt file — callers fall back to flags, loudly)."""
+    path = os.path.join(os.fspath(directory), MODEL_CONFIG_BASENAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError) as e:
+        log.warning("unreadable %s (%s); falling back to flags", path, e)
+        return None
 
 
 class Checkpointer:
@@ -40,12 +83,40 @@ class Checkpointer:
         return os.fspath(self._mgr.directory)
 
     def save(self, step: int, state: PyTree, *, force: bool = False) -> bool:
-        """Async sharded save. Returns True if a save was actually queued."""
+        """Async sharded save. Returns True if a save was actually queued.
+
+        When ``state`` carries a params subtree (TrainState attribute or
+        dict key), it is ALSO saved as a separate ``params`` item next to
+        the full ``state`` item, so a serving process can restore just the
+        weights instead of reading ~3x params bytes of dead opt_state
+        (:meth:`restore_params`). Anything else keeps the legacy
+        single-item layout.
+
+        Deliberate cost: the params bytes are stored twice (~25% more per
+        Adam checkpoint). The alternative — state-minus-params plus
+        reassembly on every restore path — would complicate
+        restore/restore_raw/preemption-resume for a storage win that
+        ``max_to_keep`` already bounds; revisit if checkpoints outgrow it.
+        """
         step = int(step)
         if step in self._mgr.all_steps():
             return False
-        return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                              force=force)
+        params = getattr(state, "params", None)
+        if params is None and isinstance(state, dict):
+            params = state.get("params")
+        if params is None:
+            return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                  force=force)
+        return self._mgr.save(
+            step,
+            args=ocp.args.Composite(state=ocp.args.StandardSave(state),
+                                    params=ocp.args.StandardSave(params)),
+            force=force)
+
+    def _has_item(self, step: int, item: str) -> bool:
+        """True when ``step`` was saved in the two-item layout and carries
+        ``item`` (legacy checkpoints keep everything under ``default``)."""
+        return os.path.isdir(os.path.join(self.directory, str(step), item))
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -65,30 +136,69 @@ class Checkpointer:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=x.sharding)
             if isinstance(x, jax.Array) else x, target)
+        if self._has_item(step, "state"):
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract)))["state"]
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
 
     def restore_raw(self, step: int | None = None) -> PyTree:
         """Restore exactly as saved, no target tree required.
 
-        The serving-side entry: a decode process wants the params out of a
-        training checkpoint without reconstructing the optimizer (whose
-        state shapes it can't know). StandardSave'd pytrees come back as
-        nested dicts — a saved TrainState yields keys ``params`` /
-        ``opt_state`` / ``step`` / ``extra`` / ``rng``.
+        StandardSave'd pytrees come back as nested dicts — a saved
+        TrainState yields keys ``params`` / ``opt_state`` / ``step`` /
+        ``extra`` / ``rng``.
 
         Known cost: the FULL saved tree is read (opt-state included, ~3x
-        params bytes for Adam) — Orbax's Standard handler, which our saves
-        use, pairs only with StandardRestore and has no partial-subtree
-        restore (PyTreeRestore(partial_restore=True) raises a
-        handler-mismatch ValueError against StandardSave'd checkpoints).
-        A one-time startup cost for a serving process; revisit if Orbax
-        grows partial StandardRestore.
+        params bytes for Adam) — Orbax's Standard handler pairs only with
+        StandardRestore and has no partial-subtree restore
+        (PyTreeRestore(partial_restore=True) raises a handler-mismatch
+        ValueError against StandardSave'd checkpoints). Serving should use
+        :meth:`restore_params`, which reads the separate ``params`` item
+        new saves write and pays this cost only on legacy checkpoints.
         """
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}")
-        return self._mgr.restore(step)
+        if self._has_item(step, "state"):
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore()))["state"]
+        try:
+            return self._mgr.restore(step)
+        except KeyError:
+            # a manager that has not saved this session cannot infer the
+            # legacy single-item handler — name it explicitly
+            return self._mgr.restore(step,
+                                     args=ocp.args.StandardRestore())
+
+    def restore_params(self, step: int | None = None) -> PyTree:
+        """Params-only restore — the serving startup entry.
+
+        New checkpoints carry a dedicated ``params`` item (see
+        :meth:`save`): only the weight bytes are read. Legacy single-item
+        checkpoints fall back to :meth:`restore_raw` (full-tree read,
+        opt_state included) with a warning, so old logdirs keep serving.
+        """
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        if self._has_item(step, "params"):
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(
+                    params=ocp.args.StandardRestore()))["params"]
+        log.warning(
+            "step %d at %s predates the params-only item; falling back to "
+            "the full-tree restore (~3x params bytes of dead opt_state)",
+            step, self.directory)
+        raw = self.restore_raw(step)
+        if not isinstance(raw, dict) or "params" not in raw:
+            raise ValueError(
+                f"checkpoint step {step} at {self.directory} has no "
+                "'params' subtree — not a TrainState checkpoint?")
+        return raw["params"]
 
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:
         """(state, restored_step) — state unchanged if nothing on disk."""

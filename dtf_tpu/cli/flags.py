@@ -228,6 +228,49 @@ def resolve_loss_l2(FLAGS, recipe_l2: float):
     return 0.0
 
 
+#: decode-config fields the checkpoint manifest is authoritative for: a
+#: hand-matched mismatch on any of these silently garbles decode (wrong
+#: head count reads the cache at the wrong stride — no shape error).
+DECODE_MANIFEST_FIELDS = ("size", "kv_heads", "attn_window",
+                          "attn_global_every")
+
+
+def resolve_decode_config(FLAGS, manifest):
+    """Merge the checkpoint's ``model_config.json`` manifest into the
+    serving flags (``generate_gpt.py`` / ``serve_gpt.py``).
+
+    Manifest present: its architecture fields WIN — an explicitly passed
+    flag that contradicts it raises (the mismatch used to garble decode
+    silently), a matching or unset flag just follows it. No manifest (old
+    checkpoint): flags pass through untouched, exactly the old contract.
+    ``kv_cache_dtype`` is a serving-side choice, not an architecture fact,
+    so the flag always wins and the manifest only supplies a default.
+    Raises ValueError — launchers convert to their UsageError.
+    """
+    out = {f: getattr(FLAGS, f) for f in DECODE_MANIFEST_FIELDS}
+    out["kv_cache_dtype"] = getattr(FLAGS, "kv_cache_dtype", "")
+    if manifest is None:
+        return out
+    if int(manifest.get("moe_every", 0) or 0):
+        raise ValueError(
+            f"checkpoint was trained with moe_every="
+            f"{manifest['moe_every']}; the decode stack has no MoE path — "
+            "serving a Switch-MoE checkpoint would silently drop the "
+            "expert weights")
+    for f in DECODE_MANIFEST_FIELDS:
+        if f not in manifest:
+            continue
+        if FLAGS[f].present and getattr(FLAGS, f) != manifest[f]:
+            raise ValueError(
+                f"--{f}={getattr(FLAGS, f)!r} contradicts the checkpoint "
+                f"manifest ({manifest[f]!r}); drop the flag — the manifest "
+                "written by the training launcher is authoritative")
+        out[f] = manifest[f]
+    if not FLAGS["kv_cache_dtype"].present and "kv_cache_dtype" in manifest:
+        out["kv_cache_dtype"] = manifest["kv_cache_dtype"]
+    return out
+
+
 def resolve_grad_shard(FLAGS, mesh, *, blockers=()):
     """``--grad_shard`` viability — the safe-fallback gate (docs/ZERO.md).
 

@@ -101,6 +101,17 @@ class GPTConfig:
     #: (``generate(..., prefill_chunk=...)``). Static flag — the default
     #: one-shot prefill keeps its flash-kernel fast path.
     chunked_prefill: bool = False
+    #: continuous-batching decode mode (:mod:`dtf_tpu.serve`): the
+    #: ``cache_index`` variable is PER-ROW ([B] int32, one independent
+    #: position per batch slot) instead of one scalar shared by the whole
+    #: batch, so each slot of a serving batch can sit at a different
+    #: sequence position — a slot resets to index 0 when a new request is
+    #: admitted while its neighbors keep decoding. Single-token steps only
+    #: (prefill goes through a sliced batch-1 ``chunked_prefill`` model —
+    #: see ``serve/engine.py``); a stale slot's old contents need no
+    #: clearing because slot validity is derived from the index
+    #: (``p_s >= 0`` masks every slot the new request hasn't written).
+    slot_decode: bool = False
     #: latency-hiding collective matmul for the Megatron TP projections
     #: (q/k/v + attn_out, mlp_in/mlp_out): the blocking all-gather /
     #: reduce-scatter GSPMD schedules around each sharded einsum becomes a
@@ -127,6 +138,15 @@ class GPTConfig:
             raise ValueError(
                 f"kv_cache_dtype={self.kv_cache_dtype!r} must be '' (store "
                 "at dtype) or 'int8'")
+        if self.slot_decode and self.decode_len <= 0:
+            raise ValueError(
+                "slot_decode requires decode_len > 0 (it is a property of "
+                "the KV-cache decode mode)")
+        if self.slot_decode and self.chunked_prefill:
+            raise ValueError(
+                "slot_decode and chunked_prefill are different models of "
+                "the same cache: the serving engine slices one slot into a "
+                "batch-1 chunked_prefill model instead (serve/engine.py)")
 
     def layer_window(self, layer: int) -> int:
         """Effective sliding window for layer ``layer`` (0-indexed): 0 when
@@ -200,10 +220,15 @@ tp_rules = [
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     """Rotary embedding. x [B,H,T,D] (D even), positions [T] global indices —
-    correct under seq sharding because positions are global, not local."""
+    correct under seq sharding because positions are global, not local.
+    ``positions`` may also be PER-ROW [B,T] (the ``slot_decode`` step, where
+    every serving slot sits at its own position); the angles then broadcast
+    over heads only."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [T,D/2]
+    angles = positions[..., None].astype(jnp.float32) * freqs    # [...,T,D/2]
+    if angles.ndim == 3:                   # [B,T,D/2] → broadcast over heads
+        angles = angles[:, None]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., 0::2], x[..., 1::2]
     y1 = x1 * cos - x2 * sin
@@ -257,6 +282,30 @@ def _cache_put_dyn(cfg, cvar, svar, slot, a) -> None:
             svar.value, s, slot, axis=2)
 
 
+def _cache_put_rows(cfg, cvar, svar, slots, a, active=None) -> None:
+    """Per-row single-slot cache write (the ``slot_decode`` step): batch row
+    b writes its own slot ``slots[b]`` — the vectorized counterpart of
+    :func:`_cache_put_dyn` for per-slot cache indices. ``a`` is [B,H,1,D];
+    the two advanced indices (rows, slots) land the [B,H,D] update.
+    ``active`` [B] bool masks the write per row (inactive rows scatter
+    their CURRENT slot contents back — a gather+scatter no-op — so a slot
+    mid-prefill rides the fixed-shape decode step untouched)."""
+    rows = jnp.arange(a.shape[0])
+
+    def put(var, upd):
+        if active is not None:
+            cur = var.value[rows, :, slots, :]
+            upd = jnp.where(active[:, None, None], upd, cur)
+        var.value = var.value.at[rows, :, slots, :].set(upd)
+
+    if svar is None:
+        put(cvar, a[:, :, 0, :].astype(cfg.dtype))
+    else:
+        q, s = _kv_quant(a)
+        put(cvar, q[:, :, 0, :])
+        put(svar, s[:, :, 0, :])
+
+
 class CausalSelfAttention(nn.Module):
     cfg: GPTConfig
     mesh: Optional[Mesh]
@@ -306,17 +355,35 @@ class CausalSelfAttention(nn.Module):
                                (b, kv_heads, cache_len, 1), jnp.float32)
             sv = self.variable("cache", "value_scale", jnp.zeros,
                                (b, kv_heads, cache_len, 1), jnp.float32)
+        # slot_decode: one independent position counter per batch row (the
+        # continuous-batching mode); otherwise the classic shared scalar.
         ci = self.variable("cache", "cache_index",
-                           lambda: jnp.zeros((), jnp.int32))
+                           lambda: jnp.zeros((b,) if cfg.slot_decode else (),
+                                             jnp.int32))
         return ck, cv, sk, sv, ci, cache_len, is_initialized
 
     @nn.compact
-    def __call__(self, x, deterministic: bool):
+    def __call__(self, x, deterministic: bool, prefill_len=None,
+                 decode_active=None):
         cfg = self.cfg
         d_head = cfg.d_model // cfg.heads
         kv_heads = cfg.kv_heads_resolved
         group = cfg.heads // kv_heads
         t = x.shape[1]
+        if cfg.slot_decode and t != 1:
+            raise ValueError(
+                "slot_decode steps one token at a time (per-slot cache "
+                "indices); prefill a slot by slicing its row into a "
+                "batch-1 chunked_prefill model (serve/engine.py)")
+        if prefill_len is not None and not (
+                cfg.decode_len > 0 and t != 1 and cfg.chunked_prefill):
+            raise ValueError(
+                "prefill_len only applies to the chunked-prefill path "
+                "(decode_len > 0, chunked_prefill=True, multi-token chunk)")
+        if decode_active is not None and not (cfg.slot_decode and t == 1):
+            raise ValueError(
+                "decode_active only applies to the slot_decode step "
+                "(per-row cache indices, single-token apply)")
         # ONE projection constructor for every branch (train + decode):
         # comms.TpDense is a drop-in nn.Dense (identical param tree). With
         # --tp_overlap, q/k/v become collective ag_matmuls and attn_out a
@@ -375,9 +442,30 @@ class CausalSelfAttention(nn.Module):
             if is_initialized:
                 keep = min(cache_len, t)
                 wslots = jnp.remainder(qpos[t - keep:], cache_len)
+                pre = [None if var is None else var.value
+                       for var in (ck, cv, sk, sv)]
                 _cache_put_at(cfg, ck, sk, wslots, k[:, :, t - keep:, :])
                 _cache_put_at(cfg, cv, sv, wslots, v[:, :, t - keep:, :])
-                ci.value = start + t
+                if prefill_len is None:
+                    ci.value = start + t
+                else:
+                    # RIGHT-PADDED chunk (the serving engine's fixed-width
+                    # prefill program): only the first prefill_len tokens
+                    # are real. Their causal mask already hides the padding
+                    # from every valid query (pad sits at LATER positions),
+                    # but the rolling-buffer write may have landed pad K/V
+                    # in slots that still hold live pre-chunk positions —
+                    # restore those slots from the pre-write snapshot and
+                    # advance the index by the VALID count only. Written
+                    # slots are distinct (min(L,t) consecutive positions),
+                    # so the scatter of per-token validity is well-defined.
+                    invalid = jnp.zeros((cache_len,), bool).at[wslots].set(
+                        jnp.arange(t - keep, t) >= prefill_len)
+                    mask = invalid[None, None, :, None]
+                    for var, old in zip((ck, cv, sk, sv), pre):
+                        if var is not None:
+                            var.value = jnp.where(mask, old, var.value)
+                    ci.value = start + prefill_len
             # cache slots decode at idx_old = start-1 (newest pre-chunk
             # position congruent to s; same formula as single-token decode).
             # All-valid < start <= qpos, so causality is automatic there.
@@ -416,26 +504,44 @@ class CausalSelfAttention(nn.Module):
         elif cfg.decode_len > 0:
             # KV-cache decode: one token in, attend against all cached
             # positions <= idx. Cache layout [B, H, L, D] matches training.
+            # slot_decode: idx is PER-ROW [B] — rope positions, cache
+            # writes and the validity mask all go row-wise, so every slot
+            # of a serving batch decodes at its own position.
             b = x.shape[0]
             ck, cv, sk, sv, ci, cache_len, is_initialized = self._cache_vars(
                 b, kv_heads, d_head)
             idx = ci.value
-            pos = idx[None]
-            q = rope(q, pos, cfg.rope_theta)
-            k = rope(k, pos, cfg.rope_theta)
+            idx_b = idx if cfg.slot_decode else idx[None]        # [B] or [1]
+            q = rope(q, idx_b[:, None], cfg.rope_theta)
+            k = rope(k, idx_b[:, None], cfg.rope_theta)
             if is_initialized:
                 slot = jax.lax.rem(idx, jnp.int32(cache_len))
-                _cache_put_dyn(cfg, ck, sk, slot, k)
-                _cache_put_dyn(cfg, cv, sv, slot, v)
-                ci.value = idx + 1
+                if cfg.slot_decode:
+                    # decode_active masks the whole step per row: an
+                    # inactive slot (mid-prefill in the serving engine)
+                    # neither writes its cache nor advances its index, so
+                    # the fixed-shape all-slots step cannot corrupt it.
+                    _cache_put_rows(cfg, ck, sk, slot, k,
+                                    active=decode_active)
+                    _cache_put_rows(cfg, cv, sv, slot, v,
+                                    active=decode_active)
+                    ci.value = (idx + 1 if decode_active is None
+                                else idx + decode_active.astype(jnp.int32))
+                else:
+                    _cache_put_dyn(cfg, ck, sk, slot, k)
+                    _cache_put_dyn(cfg, cv, sv, slot, v)
+                    ci.value = idx + 1
             # slot s currently holds position p_s = idx - ((idx - s) mod L):
             # the newest position <= idx congruent to s. Valid iff p_s >= 0.
             # This single formula covers both layouts — unwritten slots of
             # the plain cache (s > idx) get p_s < 0, and a full rolling
-            # buffer keeps exactly the last L = window positions.
+            # buffer keeps exactly the last L = window positions. (It is
+            # also why slot_decode needs no cache clearing on slot reuse:
+            # resetting a row's index to 0 invalidates every stale slot.)
             slots = jnp.arange(cache_len)
-            p_s = idx - jnp.remainder(idx - slots, cache_len)
-            bias = jnp.where(p_s >= 0, 0.0, -jnp.inf)            # [L]
+            p_s = idx_b[:, None] - jnp.remainder(
+                idx_b[:, None] - slots[None, :], cache_len)
+            bias = jnp.where(p_s >= 0, 0.0, -jnp.inf)            # [B|1, L]
             # Grouped attention straight against the un-expanded cache:
             # materializing expand_kv(cache) would re-read group x the cache
             # bytes per token per layer — the exact cost GQA removes. Query
@@ -445,7 +551,7 @@ class CausalSelfAttention(nn.Module):
             qg = q[:, :, 0, :].reshape(b, kv_heads, group, d_head)
             s = jnp.einsum("bkgd,bkld->bkgl", qg, keys,
                            preferred_element_type=jnp.float32)
-            s = s * d_head ** -0.5 + bias[None, None, None, :]
+            s = s * d_head ** -0.5 + bias[:, None, None, :]
             p = jax.nn.softmax(s, axis=-1)  # >=1 valid key: no dead rows
             out = jnp.einsum("bkgl,bkld->bkgd", p.astype(vals.dtype),
                              vals, preferred_element_type=jnp.float32)
@@ -559,14 +665,17 @@ class Block(nn.Module):
     manual_seq: bool = False  # see CausalSelfAttention.manual_seq
 
     @nn.compact
-    def __call__(self, x, deterministic: bool):
+    def __call__(self, x, deterministic: bool, prefill_len=None,
+                 decode_active=None):
         cfg = self.cfg
         overlap = (cfg.tp_overlap and self.mesh is not None
                    and not self.manual_seq)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln1")(x)
         x = x + CausalSelfAttention(cfg, self.mesh, self.window,
                                     manual_seq=self.manual_seq,
-                                    name="attention")(h, deterministic)
+                                    name="attention")(h, deterministic,
+                                                      prefill_len,
+                                                      decode_active)
         if overlap:
             x = comms.tp_token_sharded(x, self.mesh)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln2")(x)
@@ -602,7 +711,8 @@ class GPT(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, *, deterministic: bool = True,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, prefill_len=None,
+                 decode_active=None):
         cfg = self.cfg
         overlap = cfg.tp_overlap and self.mesh is not None
         x = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
@@ -622,7 +732,8 @@ class GPT(nn.Module):
         for i in range(cfg.layers):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             x = block(cfg, self.mesh, use_moe, cfg.layer_window(i),
-                      name=f"layer_{i}")(x, deterministic)
+                      name=f"layer_{i}")(x, deterministic, prefill_len,
+                                         decode_active)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         if return_hidden:
             # the chunked-loss path applies lm_head itself; the Dense
@@ -719,6 +830,34 @@ def filter_logits(logits: jax.Array, *, top_k: int = 0,
                          keepdims=True)
         logits = jnp.where(logits < thresh, -jnp.inf, logits)
     return logits
+
+
+def filter_logits_dynamic(logits: jax.Array, *, top_k: jax.Array,
+                          top_p: jax.Array) -> jax.Array:
+    """:func:`filter_logits` with TRACED ``top_k`` / ``top_p`` scalars.
+
+    The serving engine (:mod:`dtf_tpu.serve`) folds per-slot sampling
+    params into ONE fixed-shape decode program (vmapped over slots), so
+    k/p arrive as runtime values, not Python ints. Same semantics as the
+    static path — including its no-op gates: the k-filter is selected only
+    where ``top_k > 0`` and the nucleus only where ``top_p < 1``, so a
+    slot running (0, 1.0) sees BIT-identical logits to an offline
+    ``generate()`` with the filters off (the engine/offline parity
+    contract), rather than "numerically equivalent" recomputed ones.
+    """
+    vocab = logits.shape[-1]
+    desc = jnp.sort(logits, axis=-1)[..., ::-1]
+    k = jnp.clip(top_k, 1, vocab)             # only read where top_k > 0
+    order = jnp.argsort(-logits, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)       # 0 = largest logit
+    use_k = top_k > 0
+    logits = jnp.where(use_k & (ranks >= k), -jnp.inf, logits)
+    desc = jnp.where(use_k & (jnp.arange(vocab) >= k), -jnp.inf, desc)
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_p
+    thresh = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where((top_p < 1.0) & (logits < thresh), -jnp.inf, logits)
 
 
 def generate(model: GPT, params, prompt: jax.Array, n_new: int,

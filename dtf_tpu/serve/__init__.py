@@ -1,0 +1,25 @@
+"""Online inference: continuous-batching decode over the GPT flagship.
+
+The training side of the framework has carried every PR so far; this
+package is the serving side the ROADMAP north star ("serves heavy traffic
+from millions of users") actually asks for. Three layers:
+
+- :mod:`dtf_tpu.serve.engine` — ``DecodeEngine``: KV cache + per-slot
+  positions/rng/sampling-params as persistent sharded device state, with
+  exactly TWO AOT-compiled fixed-shape programs (``prefill_into_slot``,
+  ``decode_all``). Zero steady-state recompiles by construction.
+- :mod:`dtf_tpu.serve.scheduler` — request queue, FIFO admission with
+  prefill/decode interleave, slot allocation, EOS/max-len eviction, and
+  TTFT / per-token-latency / queue-depth / occupancy metrics.
+- :mod:`dtf_tpu.serve.client` — in-process submit/poll API plus a seeded
+  Poisson load generator for benching.
+
+docs/SERVING.md walks the architecture and the fixed-shape rules.
+"""
+
+from dtf_tpu.serve.client import PoissonLoadGen, ServeClient, replay
+from dtf_tpu.serve.engine import DecodeEngine, decode_step_view
+from dtf_tpu.serve.scheduler import Request, Scheduler
+
+__all__ = ["DecodeEngine", "PoissonLoadGen", "Request", "Scheduler",
+           "ServeClient", "decode_step_view", "replay"]

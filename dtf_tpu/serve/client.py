@@ -1,0 +1,129 @@
+"""In-process serving client + seeded Poisson load generator.
+
+``ServeClient`` is the submit/poll surface a caller (or the reference-style
+launcher ``scripts/serve_gpt.py``) talks to — it owns a
+:class:`~dtf_tpu.serve.scheduler.Scheduler` and pumps it. ``PoissonLoadGen``
+produces a reproducible open-loop arrival process (exponential
+inter-arrivals, seeded prompt/length sampling) for benching: the A/B
+against static batched ``generate()`` rides
+``scripts/bench_decode.py --sweep-serve``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from dtf_tpu.serve.scheduler import Request, Scheduler
+
+
+def replay(scheduler: Scheduler, arrivals, *,
+           clock=time.perf_counter, sleep=time.sleep) -> float:
+    """Open-loop arrival replay: submit each ``(t_arrival, Request)`` when
+    its wall-clock moment comes, tick the scheduler whenever work is
+    pending, and drain. Returns the makespan in seconds. THE one pump loop
+    — serve_gpt.py and the bench A/B both drive it, so admission timing
+    cannot drift between them. Returns request ids in submit order via
+    ``scheduler`` (callers poll)."""
+    arrivals = list(arrivals)
+    t0 = clock()
+    i = 0
+    while i < len(arrivals) or scheduler.pending:
+        now = clock() - t0
+        while i < len(arrivals) and arrivals[i][0] <= now:
+            scheduler.submit(arrivals[i][1])
+            i += 1
+        if scheduler.pending:
+            scheduler.tick()
+        elif i < len(arrivals):
+            sleep(min(arrivals[i][0] - now, 0.05))
+    return clock() - t0
+
+
+class ServeClient:
+    """Submit/poll API over an engine. ``submit`` returns a request id;
+    ``result`` blocks (pumping the scheduler) until that request is done."""
+
+    def __init__(self, engine, writer=None, **scheduler_kw):
+        self.scheduler = Scheduler(engine, writer, **scheduler_kw)
+
+    def submit(self, prompt: Sequence[int], *, max_new: int = 32,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               eos_id: Optional[int] = None, pad_id: int = 0,
+               seed: int = 0) -> int:
+        return self.scheduler.submit(Request(
+            prompt=list(prompt), max_new=max_new, temperature=temperature,
+            top_k=top_k, top_p=top_p, eos_id=eos_id, pad_id=pad_id,
+            seed=seed))
+
+    def poll(self, rid: int) -> dict:
+        return self.scheduler.poll(rid)
+
+    def step(self) -> None:
+        self.scheduler.tick()
+
+    def result(self, rid: int, max_ticks: int = 100000) -> list[int]:
+        """Generated tokens of ``rid`` (pumps the scheduler until done)."""
+        for _ in range(max_ticks):
+            st = self.poll(rid)
+            if st["status"] == "done":
+                return st["tokens"]
+            self.scheduler.tick()
+        raise RuntimeError(f"request {rid} not done after {max_ticks} ticks")
+
+    def drain(self) -> None:
+        self.scheduler.run_until_idle()
+
+    def stats(self) -> dict:
+        return self.scheduler.stats()
+
+
+@dataclasses.dataclass(frozen=True)
+class PoissonLoadGen:
+    """Seeded open-loop load: ``arrivals()`` yields ``(t_arrival, Request)``
+    with Exp(rate) inter-arrival gaps, prompts of uniform random length in
+    ``[prompt_min, prompt_max]`` over ``vocab_size`` tokens, and ``max_new``
+    uniform in ``[new_min, new_max]`` — the mixed-length churn continuous
+    batching exists for. Deterministic per seed (benches commit rows)."""
+
+    rate: float                       # requests per second
+    n_requests: int
+    vocab_size: int
+    prompt_min: int = 4
+    prompt_max: int = 64
+    new_min: int = 8
+    new_max: int = 64
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    seed: int = 0
+
+    def __post_init__(self):
+        # fail at construction, not mid-replay inside numpy
+        if self.rate <= 0:
+            raise ValueError(f"rate={self.rate} must be > 0")
+        if not 1 <= self.prompt_min <= self.prompt_max:
+            raise ValueError(
+                f"need 1 <= prompt_min ({self.prompt_min}) <= prompt_max "
+                f"({self.prompt_max})")
+        if not 1 <= self.new_min <= self.new_max:
+            raise ValueError(
+                f"need 1 <= new_min ({self.new_min}) <= new_max "
+                f"({self.new_max})")
+
+    def arrivals(self) -> Iterator[tuple[float, Request]]:
+        rng = np.random.default_rng(self.seed)
+        t = 0.0
+        for i in range(self.n_requests):
+            t += float(rng.exponential(1.0 / self.rate))
+            n_p = int(rng.integers(self.prompt_min, self.prompt_max + 1))
+            prompt = rng.integers(0, self.vocab_size, n_p).tolist()
+            yield t, Request(
+                prompt=prompt,
+                max_new=int(rng.integers(self.new_min, self.new_max + 1)),
+                temperature=self.temperature, top_k=self.top_k,
+                top_p=self.top_p, eos_id=self.eos_id, seed=self.seed + i)

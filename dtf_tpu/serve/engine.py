@@ -1,0 +1,460 @@
+"""``DecodeEngine`` — per-slot continuous batching over the GPT decode model.
+
+The offline decode stack (``models/gpt.py: generate``) runs one fixed batch
+start-to-finish: a single long request holds the whole batch hostage while
+finished rows idle. This engine keeps the same fixed-shape/pjit discipline
+but makes the batch dimension a SLOT pool: every row of the KV cache is an
+independent request at its own position (``GPTConfig.slot_decode`` — the
+``cache_index`` variable is per-row), so requests stream in and out of rows
+while the shapes never change.
+
+Exactly two jitted programs exist, both AOT-compiled at construction:
+
+- ``prefill_into_slot(slot, chunk, ...)`` — one fixed-width prompt chunk
+  into one slot. The slot's rows are sliced out of the engine state into a
+  batch-1 PLAIN cache (scalar ``cache_index``) and run through the
+  ``chunked_prefill`` cache-continuing model that offline
+  ``generate(prefill_chunk=...)`` already uses; the ragged last chunk is
+  right-padded and masked via the model's ``prefill_len`` (pad K/V never
+  survives in the cache, the index advances by the valid count only). On
+  the last chunk the program also samples the request's FIRST token —
+  mirroring ``generate``'s split-then-pick exactly, so engine output is
+  bit-compatible with offline decode per request.
+- ``decode_all()`` — one masked token step across ALL slots
+  (``slot_decode`` model), with per-slot temperature/top-k/top-p/eos
+  applied through :func:`dtf_tpu.models.gpt.filter_logits_dynamic` under a
+  per-slot rng stream (vmapped split-then-pick, the batch-1 ``generate``
+  stream per slot).
+
+Because both programs are compiled executables, steady state CANNOT
+recompile — a shape change would be a loud call-site error, not a silent
+retrace (``trace_counts`` exposes the per-program trace counters the fence
+test pins). State donation is deliberately off: on backfilled pre-0.5 jax a
+donated executable deserialized from the persistent compile cache drops
+aliased outputs (see core/train.py's gate and the conftest note).
+
+Sharded serving: pass ``mesh`` and TP-sharded params — the cache lands
+``P('data','model')`` (:func:`dtf_tpu.models.gpt.cache_shardings`: slots
+over data shards, heads over TP shards) and the decode step runs under
+GSPMD; the analysis registry's ``gpt_serve`` config fences the DECODE
+graph's collectives (:func:`decode_step_view`) — the per-token hot path.
+Known cost, not fenced: the sharded PREFILL dynamic-slices one slot out of
+the data-sharded batch axis with a traced index, which GSPMD spells as a
+resharding of the touched cache leaves per chunk — acceptable while
+prefill is chunk-bounded and rare relative to decode steps, but a
+per-shard slot-arithmetic shard_map is the upgrade path if sharded prefill
+ever dominates (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dtf_tpu.models import gpt
+
+PyTree = Any
+
+#: engine state keys that are flat per-slot arrays (leading dim n_slots),
+#: next to the "cache" collection. One registry so the state builder, the
+#: abstract view and the programs cannot desynchronize.
+_SLOT_ARRAYS = (
+    ("tok", jnp.int32),     # last emitted token (next decode input)
+    ("temp", jnp.float32),  # 0 = greedy, else sampling temperature
+    ("top_k", jnp.int32),   # 0 = off
+    ("top_p", jnp.float32),  # 1.0 = off
+    ("eos", jnp.int32),     # -1 = no stop token
+    ("pad", jnp.int32),     # token emitted after eos (offline parity)
+    ("done", jnp.bool_),    # has emitted eos
+    ("active", jnp.bool_),  # fully prefilled; a False row (empty slot or
+                            # mid-prefill between interleaved chunks) rides
+                            # the decode step untouched: no cache write, no
+                            # index advance, no rng consumption
+)
+
+
+def _leaf_name(path) -> str:
+    return getattr(path[-1], "key", str(path[-1]))
+
+
+def _slice_slot_cache(cache: PyTree, slot) -> PyTree:
+    """One slot's rows as a batch-1 PLAIN cache (scalar ``cache_index``)
+    for the ``chunked_prefill`` model. Leaves are selected by key path —
+    the same completeness contract as beam search's reorder
+    (``gpt._BATCH_LED_CACHE_KEYS``): an unknown leaf fails loudly instead
+    of silently riding the slot un-sliced."""
+    def leaf(path, x):
+        name = _leaf_name(path)
+        if name in gpt._BATCH_LED_CACHE_KEYS:
+            return jax.lax.dynamic_slice_in_dim(x, slot, 1, axis=0)
+        if name == "cache_index":
+            return jax.lax.dynamic_slice_in_dim(x, slot, 1, axis=0)[0]
+        raise ValueError(
+            f"unknown cache leaf {name!r}: teach serve/engine.py how to "
+            "slice it per slot (see gpt._BATCH_LED_CACHE_KEYS)")
+
+    return jax.tree_util.tree_map_with_path(leaf, cache)
+
+
+def _write_slot_cache(cache: PyTree, row: PyTree, slot) -> PyTree:
+    """Write a batch-1 plain cache back into slot ``slot``."""
+    def leaf(path, x, r):
+        name = _leaf_name(path)
+        if name in gpt._BATCH_LED_CACHE_KEYS:
+            return jax.lax.dynamic_update_slice_in_dim(x, r, slot, axis=0)
+        if name == "cache_index":
+            return jax.lax.dynamic_update_slice_in_dim(
+                x, r[None], slot, axis=0)
+        raise ValueError(f"unknown cache leaf {name!r}")
+
+    return jax.tree_util.tree_map_with_path(leaf, cache, row)
+
+
+def _pick(sub, logits_v, temp, top_k, top_p):
+    """One slot's token pick — ``generate``'s ``pick`` at batch-1 shapes
+    ([1,V] through the filter, [0] out), so the sampled stream is
+    bit-identical to an offline batch-1 ``generate`` with the same rng."""
+    safe_t = jnp.where(temp > 0.0, temp, 1.0)
+    filt = gpt.filter_logits_dynamic(logits_v[None, :] / safe_t,
+                                     top_k=top_k, top_p=top_p)
+    sampled = jax.random.categorical(sub, filt, -1)[0]
+    greedy = jnp.argmax(logits_v[None, :], -1)[0]
+    return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
+
+
+def _build_decode_fn(model: gpt.GPT):
+    """decode_all: one masked token step across all slots."""
+    def decode_fn(params, state):
+        active = state["active"]
+        logits, mut = model.apply(
+            {"params": params, "cache": state["cache"]},
+            state["tok"][:, None], deterministic=True, mutable=["cache"],
+            decode_active=active)
+        lg = logits[:, 0]                                    # [S, V] f32
+
+        def one(key, lv, temp, tk, tp):
+            s2 = jax.random.split(key)
+            return s2[0], _pick(s2[1], lv, temp, tk, tp)
+
+        rng, nxt = jax.vmap(one)(state["rng"], lg, state["temp"],
+                                 state["top_k"], state["top_p"])
+        # offline eos semantics per slot: a done row keeps stepping but
+        # emits pad; done flips AFTER the eos token itself is kept.
+        nxt = jnp.where(state["done"], state["pad"], nxt)
+        done = state["done"] | ((state["eos"] >= 0) & (nxt == state["eos"]))
+        # inactive rows are spectators: their rng/token/done rows must
+        # survive the step bit-for-bit (a mid-prefill slot's rng stream is
+        # the request's sampling stream — advancing it here would break
+        # the offline-parity contract).
+        new_state = {
+            **state, "cache": mut["cache"],
+            "rng": jnp.where(active[:, None], rng, state["rng"]),
+            "tok": jnp.where(active, nxt, state["tok"]),
+            "done": jnp.where(active, done, state["done"]),
+        }
+        return new_state, {"token": nxt, "done": done}
+
+    return decode_fn
+
+
+def _build_prefill_fn(model: gpt.GPT):
+    """prefill_into_slot: one fixed-width chunk into one slot; on the last
+    chunk, sample the request's first token (generate's split-then-pick)."""
+    def prefill_fn(params, state, slot, chunk, n_valid, reset, is_last,
+                   temp, top_k, top_p, eos, pad, key):
+        cache = state["cache"]
+        row = _slice_slot_cache(cache, slot)
+        # a fresh request starts at index 0; stale slot contents need no
+        # clearing (validity is derived from the index — gpt.py docstring)
+        row = jax.tree_util.tree_map_with_path(
+            lambda p, x: jnp.where(reset, jnp.zeros_like(x), x)
+            if _leaf_name(p) == "cache_index" else x, row)
+        logits, mut = model.apply(
+            {"params": params, "cache": row}, chunk[None, :],
+            deterministic=True, mutable=["cache"], prefill_len=n_valid)
+        cache = _write_slot_cache(cache, mut["cache"], slot)
+
+        # sampling-params rows are (re)stamped on every chunk of the
+        # request — idempotent, and the slot is fully reinitialized by its
+        # first chunk no matter who occupied it before.
+        last = jax.lax.dynamic_index_in_dim(logits[0], n_valid - 1,
+                                            axis=0, keepdims=False)  # [V]
+        key_row = jnp.where(reset, key, state["rng"][slot])
+        s2 = jax.random.split(key_row)
+        tok_new = _pick(s2[1], last, temp, top_k, top_p)
+        done_new = is_last & (eos >= 0) & (tok_new == eos)
+        new_state = {
+            **state,
+            "cache": cache,
+            "rng": state["rng"].at[slot].set(
+                jnp.where(is_last, s2[0], key_row)),
+            "tok": state["tok"].at[slot].set(
+                jnp.where(is_last, tok_new, state["tok"][slot])),
+            "temp": state["temp"].at[slot].set(temp),
+            "top_k": state["top_k"].at[slot].set(top_k),
+            "top_p": state["top_p"].at[slot].set(top_p),
+            "eos": state["eos"].at[slot].set(eos),
+            "pad": state["pad"].at[slot].set(pad),
+            "done": state["done"].at[slot].set(done_new),
+            # the slot joins decode_all only once its LAST chunk landed;
+            # until then it is a masked spectator of the all-slots step
+            "active": state["active"].at[slot].set(is_last),
+        }
+        return new_state, {"token": tok_new, "done": done_new}
+
+    return prefill_fn
+
+
+def _state_struct(cfg: gpt.GPTConfig, n_slots: int,
+                  mesh: Optional[Mesh]) -> PyTree:
+    """Abstract engine state (ShapeDtypeStructs, shardings when mesh):
+    the slot-batched cache collection plus the flat per-slot arrays."""
+    model = gpt.GPT(cfg, mesh)
+    shapes = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((n_slots, 1), jnp.int32)))
+    cache = shapes["cache"]
+    if mesh is not None:
+        csh = gpt.cache_shardings(mesh, cache)
+        cache = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh), cache, csh)
+    rep = NamedSharding(mesh, P()) if mesh is not None else None
+
+    def sds(shape, dtype):
+        if rep is None:
+            return jax.ShapeDtypeStruct(shape, dtype)
+        return jax.ShapeDtypeStruct(shape, dtype, sharding=rep)
+
+    state = {"cache": cache,
+             "rng": sds((n_slots, 2), jnp.uint32)}
+    for name, dtype in _SLOT_ARRAYS:
+        state[name] = sds((n_slots,), dtype)
+    return state
+
+
+def _zeros_like_struct(struct: PyTree) -> PyTree:
+    def leaf(s):
+        sh = getattr(s, "sharding", None)
+        if sh is not None:
+            # sharding-aware allocation: each device materializes only its
+            # shard (the same move as generate()'s sharded cache0)
+            return jnp.zeros(s.shape, s.dtype, device=sh)
+        return jnp.zeros(s.shape, s.dtype)
+
+    return jax.tree.map(leaf, struct)
+
+
+class DecodeEngine:
+    """Slot-pooled online decode over a GPT checkpoint.
+
+    ``cfg`` is the TRAINED architecture (decode fields are overridden
+    here): ``max_len`` sizes the per-slot KV cache (prompt + generated
+    tokens per request must fit), ``n_slots`` the concurrent-request pool,
+    ``prefill_chunk`` the fixed width of the prefill program (>= 2 — a
+    1-token apply would route to the decode branch). With ``mesh``, pass
+    params already sharded (``shard_tree(params, mesh, gpt.tp_rules)``).
+    """
+
+    def __init__(self, cfg: gpt.GPTConfig, params: PyTree, *, n_slots: int,
+                 max_len: int, prefill_chunk: int = 16,
+                 mesh: Optional[Mesh] = None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots={n_slots} must be >= 1")
+        if max_len < 2:
+            raise ValueError(f"max_len={max_len} must be >= 2 "
+                             "(prompt + at least one generated token)")
+        if prefill_chunk < 2:
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} must be >= 2: a 1-token "
+                "apply routes to the single-token decode branch, not the "
+                "chunked-prefill path")
+        base = dataclasses.replace(cfg, decode_len=max_len,
+                                   slot_decode=False, chunked_prefill=False)
+        # the chunk may not be wider than ANY layer's cache: the rolling-
+        # buffer write keeps only the last cache_len CHUNK positions, and
+        # right-padding sits at the chunk's end — a wider chunk would push
+        # valid prompt tokens out of the write window (their K/V silently
+        # dropped, decode garbled with no shape error).
+        min_cache = min(
+            (min(max_len, w) if (w := base.layer_window(i)) else max_len)
+            for i in range(base.layers))
+        if prefill_chunk > min_cache:
+            raise ValueError(
+                f"prefill_chunk={prefill_chunk} exceeds the smallest "
+                f"per-layer cache length {min_cache} (max_len={max_len}, "
+                f"attn_window={base.attn_window}); a right-padded chunk "
+                "wider than the cache drops valid prompt K/V")
+        self.cfg = base
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.prefill_chunk = prefill_chunk
+        self.mesh = mesh
+        if mesh is None:
+            # a restored checkpoint carries the TRAINING mesh's shardings;
+            # unsharded serving runs on one device, and the AOT-compiled
+            # programs (unlike plain jit) reject mismatched input shardings
+            # instead of re-lowering — commit params here once.
+            dev = jax.devices()[0]
+            params = jax.tree.map(lambda x: jax.device_put(x, dev), params)
+        self._params = params
+        self._decode_model = gpt.GPT(
+            dataclasses.replace(base, slot_decode=True), mesh)
+        self._prefill_model = gpt.GPT(
+            dataclasses.replace(base, chunked_prefill=True), mesh)
+
+        struct = _state_struct(dataclasses.replace(base, slot_decode=True),
+                               n_slots, mesh)
+        self._state = _zeros_like_struct(struct)
+        # engine defaults that zeros get wrong: nucleus off, no stop token
+        self._state["top_p"] = self._state["top_p"] + 1.0
+        self._state["eos"] = self._state["eos"] - 1
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            self._state["top_p"] = jax.device_put(self._state["top_p"], rep)
+            self._state["eos"] = jax.device_put(self._state["eos"], rep)
+
+        #: traces per program — the recompile fence. AOT compilation below
+        #: traces each exactly once; any later increment would mean a
+        #: shape-driven retrace, which the compiled executables make
+        #: impossible by construction (they reject new shapes instead).
+        self.trace_counts = {"prefill": 0, "decode": 0}
+        decode_fn = _build_decode_fn(self._decode_model)
+        prefill_fn = _build_prefill_fn(self._prefill_model)
+
+        def counted(name, fn):
+            def wrapped(*args):
+                self.trace_counts[name] += 1
+                return fn(*args)
+            return wrapped
+
+        abs_params = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=x.sharding if mesh is not None else None),
+            params)
+        abs_state = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=x.sharding if mesh is not None else None),
+            self._state)
+        s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+        s_f32 = jax.ShapeDtypeStruct((), jnp.float32)
+        s_bool = jax.ShapeDtypeStruct((), jnp.bool_)
+        jit_kw = {}
+        if mesh is not None:
+            # pin the OUTPUT state to the input layout: GSPMD would
+            # otherwise pick its own output shardings, and the next call
+            # of the AOT executable would reject the resharded state
+            rep = NamedSharding(mesh, P())
+            state_sh = jax.tree.map(lambda s: s.sharding, abs_state)
+            jit_kw["out_shardings"] = (state_sh,
+                                       {"token": rep, "done": rep})
+        self._decode_c = jax.jit(counted("decode", decode_fn),
+                                 **jit_kw).lower(
+            abs_params, abs_state).compile()
+        self._prefill_c = jax.jit(counted("prefill", prefill_fn),
+                                  **jit_kw).lower(
+            abs_params, abs_state, s_i32,
+            jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32), s_i32,
+            s_bool, s_bool, s_f32, s_i32, s_f32, s_i32, s_i32,
+            jax.ShapeDtypeStruct((2,), jnp.uint32)).compile()
+
+    # ------------------------------------------------------------- host API
+
+    def n_chunks(self, prompt_len: int) -> int:
+        return math.ceil(prompt_len / self.prefill_chunk)
+
+    def prefill_chunk_into(self, slot: int, prompt: Sequence[int],
+                           chunk_i: int, *, temperature: float = 0.0,
+                           top_k: int = 0, top_p: float = 1.0,
+                           eos_id: Optional[int] = None, pad_id: int = 0,
+                           seed: int = 0) -> Optional[tuple[int, bool]]:
+        """Run prompt chunk ``chunk_i`` of a request into ``slot`` — the
+        scheduler's prefill/decode interleave granularity (decode_all may
+        run between chunks; the slot stays a masked spectator until its
+        last chunk lands). Returns ``(first_token, done)`` on the last
+        chunk, None before."""
+        prompt = list(int(t) for t in prompt)
+        if not 1 <= len(prompt) <= self.max_len - 1:
+            raise ValueError(
+                f"prompt length {len(prompt)} must be in [1, "
+                f"{self.max_len - 1}] (max_len={self.max_len} covers "
+                "prompt + generated tokens)")
+        if not 0 <= slot < self.n_slots:
+            raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
+        c = self.prefill_chunk
+        n = self.n_chunks(len(prompt))
+        if not 0 <= chunk_i < n:
+            raise ValueError(f"chunk {chunk_i} out of range [0, {n})")
+        seg = prompt[chunk_i * c:(chunk_i + 1) * c]
+        buf = np.zeros((c,), np.int32)
+        buf[:len(seg)] = seg
+        last = chunk_i == n - 1
+        self._state, out = self._prefill_c(
+            self._params, self._state, np.int32(slot), buf,
+            np.int32(len(seg)), np.bool_(chunk_i == 0), np.bool_(last),
+            np.float32(temperature), np.int32(top_k), np.float32(top_p),
+            np.int32(-1 if eos_id is None else eos_id), np.int32(pad_id),
+            np.asarray(jax.random.PRNGKey(seed), np.uint32))
+        if not last:
+            return None
+        return int(out["token"]), bool(out["done"])
+
+    def prefill(self, slot: int, prompt: Sequence[int],
+                **sampling) -> tuple[int, bool]:
+        """Admit a request into ``slot``: stream its whole prompt through
+        the compiled chunk program and sample the first token. Returns
+        ``(first_token, done)``."""
+        n = self.n_chunks(len(prompt))
+        if n == 0:
+            # the per-chunk validation never runs on an empty prompt —
+            # fail here, not with a None return at the caller's unpack
+            raise ValueError(
+                f"prompt length 0 must be in [1, {self.max_len - 1}]")
+        out = None
+        for i in range(n):
+            out = self.prefill_chunk_into(slot, prompt, i, **sampling)
+        return out
+
+    def decode(self) -> tuple[np.ndarray, np.ndarray]:
+        """One masked token step across all slots. Returns
+        ``(tokens [n_slots], done [n_slots])`` as host arrays — the one
+        device→host sync per generated token (EOS and delivery decisions
+        live on the host)."""
+        self._state, out = self._decode_c(self._params, self._state)
+        return np.asarray(out["token"]), np.asarray(out["done"])
+
+    def cache_bytes(self) -> int:
+        """Resident KV-cache footprint (all slots, all layers)."""
+        return sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                   for x in jax.tree.leaves(self._state["cache"]))
+
+
+def decode_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
+                     mesh: Optional[Mesh] = None):
+    """The engine's decode program as an analyzable step:
+    ``(jitted_fn, abstract_params, abstract_state)`` — what the analysis
+    registry's ``gpt_serve`` config lowers so the comms-budget fence
+    covers the serving decode graph exactly as ``DecodeEngine`` compiles
+    it (same model, same state layout, same shardings)."""
+    from dtf_tpu.core.sharding import tree_shardings
+
+    dec_cfg = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True)
+    model = gpt.GPT(dec_cfg, mesh)
+    step = jax.jit(_build_decode_fn(model))
+    shapes = jax.eval_shape(lambda: model.init(
+        jax.random.PRNGKey(0), jnp.zeros((n_slots, 1), jnp.int32)))
+    abs_params = shapes["params"]
+    if mesh is not None:
+        abs_params = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            abs_params, tree_shardings(abs_params, mesh, gpt.tp_rules))
+    abs_state = _state_struct(dec_cfg, n_slots, mesh)
+    return step, abs_params, abs_state

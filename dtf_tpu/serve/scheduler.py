@@ -1,0 +1,244 @@
+"""Request scheduling over a :class:`~dtf_tpu.serve.engine.DecodeEngine`.
+
+FIFO admission with prefill/decode interleave: each :meth:`Scheduler.tick`
+runs at most ``prefill_chunks_per_tick`` prompt chunks (admitting queued
+requests into free slots as chunk budget allows — a long prompt spreads its
+prefill over several ticks instead of stalling everyone's decode), then one
+``decode_all`` step for every occupied slot. Slots are evicted on EOS, on
+``max_new``, or when the slot's ``max_len`` budget fills; the freed slot is
+immediately reusable next tick — the continuous-batching loop.
+
+Observability rides :class:`dtf_tpu.metrics.MetricWriter` (the training
+stack's writer): queue depth and slot occupancy per logging interval, plus
+per-request TTFT and per-token latency on completion. ``stats()`` returns
+the same aggregates for benches (``scripts/serve_gpt.py`` prints them as
+its one JSON line).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One decode request. Sampling fields mirror ``gpt.generate``."""
+
+    prompt: Sequence[int]
+    max_new: int = 32
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    eos_id: Optional[int] = None
+    pad_id: int = 0
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class _Rec:
+    rid: int
+    req: Request
+    status: str = "queued"            # queued | prefill | running | done
+    slot: int = -1
+    chunks_done: int = 0
+    tokens: list = dataclasses.field(default_factory=list)
+    submit_t: float = 0.0
+    first_token_t: float = 0.0
+    finish_t: float = 0.0
+
+
+def _quantile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+class Scheduler:
+    """FIFO continuous-batching scheduler (see module docstring).
+
+    ``prefill_chunks_per_tick`` bounds how much prefill work may delay the
+    next decode step (0 = admit greedily, whole queue's worth per tick).
+    ``clock`` is injectable for deterministic tests.
+    """
+
+    def __init__(self, engine, writer=None, *, log_every: int = 0,
+                 prefill_chunks_per_tick: int = 4, clock=time.monotonic,
+                 completed_cap: int = 100_000):
+        self.engine = engine
+        self.writer = writer
+        self.log_every = log_every
+        if prefill_chunks_per_tick < 0:
+            # a negative budget would be truthy in tick()'s `or 10**9`
+            # fallback yet fail `> 0` — admission silently off, replay()
+            # spinning forever on a non-empty queue
+            raise ValueError(
+                f"prefill_chunks_per_tick={prefill_chunks_per_tick} must "
+                "be >= 0 (0 = admit greedily)")
+        self.prefill_chunks_per_tick = prefill_chunks_per_tick
+        self.clock = clock
+        #: completed records (and latency samples) retained for poll();
+        #: beyond the cap the OLDEST finished request is forgotten — a
+        #: long-running server must not grow host memory per request.
+        #: poll() of a forgotten id raises KeyError; callers that need a
+        #: result must collect it within cap completions (or raise the cap).
+        self.completed_cap = completed_cap
+        self._free = list(range(engine.n_slots))
+        self._queue: collections.deque[_Rec] = collections.deque()
+        self._admitting: Optional[_Rec] = None
+        self._running: dict[int, _Rec] = {}
+        self._recs: dict[int, _Rec] = {}
+        self._done_order: collections.deque[int] = collections.deque()
+        self._next_id = 0
+        self._tick = 0
+        self._ttfts: collections.deque[float] = collections.deque(
+            maxlen=completed_cap)
+        self._tok_lats: collections.deque[float] = collections.deque(
+            maxlen=completed_cap)
+        self._completed = 0
+        self._occupancy_sum = 0.0
+        self._queue_peak = 0
+
+    # ----------------------------------------------------------- submit/poll
+
+    def submit(self, req: Request) -> int:
+        if not 1 <= len(req.prompt) <= self.engine.max_len - 1:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} must be in "
+                f"[1, {self.engine.max_len - 1}]")
+        if req.max_new < 1:
+            raise ValueError(f"max_new={req.max_new} must be >= 1")
+        rid = self._next_id
+        self._next_id += 1
+        rec = _Rec(rid, req, submit_t=self.clock())
+        self._recs[rid] = rec
+        self._queue.append(rec)
+        self._queue_peak = max(self._queue_peak, len(self._queue))
+        return rid
+
+    def poll(self, rid: int) -> dict:
+        rec = self._recs[rid]
+        return {"status": rec.status, "tokens": list(rec.tokens)}
+
+    @property
+    def pending(self) -> int:
+        """Requests not yet finished (queued + prefilling + running)."""
+        return (len(self._queue) + (self._admitting is not None)
+                + len(self._running))
+
+    # ------------------------------------------------------------------ tick
+
+    def tick(self) -> None:
+        """One scheduling round: bounded prefill, then one decode step."""
+        self._tick += 1
+        budget = self.prefill_chunks_per_tick or 10 ** 9
+        while budget > 0:
+            if self._admitting is None:
+                if not (self._queue and self._free):
+                    break
+                rec = self._queue.popleft()
+                rec.slot = self._free.pop(0)
+                rec.status = "prefill"
+                self._admitting = rec
+            rec = self._admitting
+            r = rec.req
+            out = self.engine.prefill_chunk_into(
+                rec.slot, r.prompt, rec.chunks_done,
+                temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+                eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed)
+            rec.chunks_done += 1
+            budget -= 1
+            if out is not None:                      # last chunk: tok0
+                tok, done = out
+                rec.first_token_t = self.clock()
+                rec.tokens.append(tok)
+                self._admitting = None
+                self._ttfts.append(rec.first_token_t - rec.submit_t)
+                if done or self._budget_spent(rec):
+                    self._finish(rec)
+                else:
+                    rec.status = "running"
+                    self._running[rec.slot] = rec
+
+        if self._running:
+            toks, dones = self.engine.decode()
+            now = self.clock()
+            for slot, rec in list(self._running.items()):
+                rec.tokens.append(int(toks[slot]))
+                if bool(dones[slot]) or self._budget_spent(rec):
+                    rec.finish_t = now
+                    self._finish(rec)
+        self._occupancy_sum += self._occupancy()
+
+        if (self.writer is not None and self.log_every
+                and self._tick % self.log_every == 0):
+            self.writer.write_scalars(self._tick, self.stats(brief=True))
+
+    def run_until_idle(self, max_ticks: int = 100000) -> None:
+        for _ in range(max_ticks):
+            if not self.pending:
+                return
+            self.tick()
+        raise RuntimeError(f"requests still pending after {max_ticks} ticks")
+
+    # ------------------------------------------------------------- internals
+
+    def _budget_spent(self, rec: _Rec) -> bool:
+        return (len(rec.tokens) >= rec.req.max_new
+                or len(rec.req.prompt) + len(rec.tokens) >= self.engine.max_len)
+
+    def _occupancy(self) -> float:
+        return 1.0 - len(self._free) / self.engine.n_slots
+
+    def _finish(self, rec: _Rec) -> None:
+        rec.status = "done"
+        rec.finish_t = rec.finish_t or self.clock()
+        if len(rec.tokens) > 1:
+            self._tok_lats.append((rec.finish_t - rec.first_token_t)
+                                  / (len(rec.tokens) - 1))
+        self._completed += 1
+        self._running.pop(rec.slot, None)
+        self._free.append(rec.slot)
+        self._free.sort()
+        rec.slot = -1
+        self._done_order.append(rec.rid)
+        while len(self._done_order) > self.completed_cap:
+            self._recs.pop(self._done_order.popleft(), None)
+
+    def release(self, rid: int) -> None:
+        """Drop a completed request's record (tokens included) — call after
+        consuming the result to keep a long-running server's host memory
+        flat without relying on the completed_cap backstop."""
+        rec = self._recs.get(rid)
+        if rec is not None and rec.status == "done":
+            self._recs.pop(rid, None)
+
+    # --------------------------------------------------------------- metrics
+
+    def stats(self, brief: bool = False) -> dict:
+        """Aggregate serving metrics (floats, MetricWriter-compatible)."""
+        out = {
+            "serve_queue_depth": float(len(self._queue)
+                                       + (self._admitting is not None)),
+            "serve_occupancy": self._occupancy(),
+            "serve_completed": float(self._completed),
+        }
+        if brief:
+            if self._ttfts:
+                out["serve_ttft_last_s"] = self._ttfts[-1]
+            return out
+        out.update({
+            "serve_ticks": float(self._tick),
+            "serve_queue_peak": float(self._queue_peak),
+            "serve_occupancy_mean": (self._occupancy_sum / self._tick
+                                     if self._tick else 0.0),
+            "serve_ttft_p50_s": _quantile(self._ttfts, 0.5),
+            "serve_ttft_p99_s": _quantile(self._ttfts, 0.99),
+            "serve_tok_latency_p50_s": _quantile(self._tok_lats, 0.5),
+            "serve_tok_latency_p99_s": _quantile(self._tok_lats, 0.99),
+        })
+        return out

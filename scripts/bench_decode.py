@@ -19,6 +19,11 @@ touching the training rows.
 Timing: the whole generate() scan is ONE dispatch over the tunnel (~639
 sequential steps), so the ~75 ms round trip is noise — no scan-folding
 needed (contrast scripts/bench_attention.py tpu_child).
+
+``--sweep-serve``: the continuous-batching A/B (``child_serve``) — the
+dtf_tpu/serve engine vs a classic fixed-batch server under the same seeded
+Poisson arrivals; goodput tokens/sec + TTFT p50/p99 both sides, merged
+into ``BENCH_LM.json`` under ``"serve"``.
 """
 
 import json
@@ -116,6 +121,99 @@ def child():
     print(SENTINEL + json.dumps(row))
 
 
+def child_serve():
+    """Continuous-vs-static A/B under the SAME seeded Poisson arrivals:
+    the serve side runs the DecodeEngine + Scheduler (per-slot eviction
+    frees capacity the moment a request finishes), the static side is the
+    classic fixed-batch server (collect n_slots requests, decode the
+    worst-case new_max for the whole batch, deliver at batch end — the
+    long-request-holds-the-batch cost this engine exists to remove).
+    Prompt length is fixed per row (static batching cannot mix lengths);
+    the generation lengths vary, which is the headline effect. One JSON
+    row with both sides."""
+    import dataclasses
+
+    import jax
+    import numpy as np
+
+    from _dtf_watchdog import fence
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve import (DecodeEngine, PoissonLoadGen, Scheduler,
+                               replay)
+    from dtf_tpu.serve.scheduler import _quantile
+
+    tiny = os.environ.get("DTF_DECODE_TINY") == "1"
+    if tiny:
+        base = gpt.GPTConfig.tiny(dtype=jax.numpy.bfloat16)
+        n_slots, t_p, new_min, new_max = 4, 8, 4, 16
+        rate, n_req, chunk = 200.0, 12, 8
+    else:
+        base = gpt.GPTConfig.gpt2_small()
+        n_slots, t_p, new_min, new_max = 8, 128, 64, 512
+        rate, n_req, chunk = 2.0, 24, 64
+    rate = float(os.environ.get("DTF_SERVE_RATE", rate))
+    n_req = int(os.environ.get("DTF_SERVE_N", n_req))
+    max_len = t_p + new_max
+    cfg = dataclasses.replace(base, decode_len=max_len)
+    model = gpt.GPT(cfg, None)
+    params = model.init(jax.random.PRNGKey(0),
+                        jax.numpy.zeros((1, 1), jax.numpy.int32))["params"]
+    gen = PoissonLoadGen(rate=rate, n_requests=n_req,
+                         vocab_size=base.vocab_size, prompt_min=t_p,
+                         prompt_max=t_p, new_min=new_min, new_max=new_max,
+                         seed=0)
+    arrivals = list(gen.arrivals())
+
+    # ---- serve side: open-loop Poisson against the engine
+    engine = DecodeEngine(base, params, n_slots=n_slots, max_len=max_len,
+                          prefill_chunk=chunk)
+    sched = Scheduler(engine, None, prefill_chunks_per_tick=4)
+    serve_wall = replay(sched, arrivals)
+    goodput = sum(len(sched.poll(r)["tokens"]) for r in range(n_req))
+    st = sched.stats()
+    serve = {"tokens_per_sec": round(goodput / max(serve_wall, 1e-9), 1),
+             "makespan_s": round(serve_wall, 3),
+             "ttft_p50_s": round(st["serve_ttft_p50_s"], 5),
+             "ttft_p99_s": round(st["serve_ttft_p99_s"], 5),
+             "occupancy_mean": round(st["serve_occupancy_mean"], 3)}
+
+    # ---- static side: same arrivals, fixed batches, worst-case decode.
+    # TTFT for a static server is delivery time: batch end - arrival (a
+    # request's tokens only return when its whole batch completes).
+    run = jax.jit(lambda p, ids: gpt.generate(model, p, ids, new_max))
+    warm_ids = jax.numpy.zeros((n_slots, t_p), jax.numpy.int32)
+    fence(run(params, warm_ids))                      # compile outside t0
+    t0 = time.perf_counter()
+    done_t, end = [], 0.0
+    for b0 in range(0, n_req, n_slots):
+        batch = arrivals[b0:b0 + n_slots]
+        now = time.perf_counter() - t0
+        start = max(end, batch[-1][0])                # wait for the batch
+        if start > now:
+            time.sleep(start - now)
+        ids = np.zeros((n_slots, t_p), np.int32)
+        for j, (_, req) in enumerate(batch):
+            ids[j] = req.prompt
+        fence(run(params, jax.numpy.asarray(ids)))
+        end = time.perf_counter() - t0
+        done_t += [end - arr for arr, _ in batch]
+    static_wall = end
+    want = sum(req.max_new for _, req in arrivals)    # goodput: wanted only
+    # same rank definition as the serve side's scheduler stats — a hand-
+    # rolled quantile here would bias the A/B by one rank at small N
+    static = {"tokens_per_sec": round(want / max(static_wall, 1e-9), 1),
+              "makespan_s": round(static_wall, 3),
+              "ttft_p50_s": round(_quantile(done_t, 0.5), 5),
+              "ttft_p99_s": round(_quantile(done_t, 0.99), 5)}
+
+    row = {"model": ("gpt_tiny" if tiny else "gpt2_small") + "_serve_ab",
+           "backend": jax.default_backend(), "n_slots": n_slots,
+           "prompt": t_p, "new_min": new_min, "new_max": new_max,
+           "rate_rps": rate, "n_requests": n_req, "prefill_chunk": chunk,
+           "serve": serve, "static": static}
+    print(SENTINEL + json.dumps(row))
+
+
 def _read() -> dict:
     try:
         with open(ARTIFACT) as f:
@@ -124,29 +222,43 @@ def _read() -> dict:
         return {}
 
 
-def _merge(rows, errors):
+def _merge(rows, errors, key="decode"):
     data = _read()
-    data["decode"] = {"rows": rows, "errors": errors}
+    data[key] = {"rows": rows, "errors": errors}
     with open(ARTIFACT, "w") as f:
         json.dump(data, f, indent=1)
 
 
-def main():
+def main(key="decode"):
     from _dtf_watchdog import Budget, child_argv, probe_backend, \
         run_budgeted_jobs
 
     budget = Budget(TOTAL_BUDGET_S)
     backend, probe_errors = probe_backend(env=dict(os.environ))
     if backend is None:
-        # append the outage; keep any previously measured decode rows
+        # append the outage; keep any previously measured rows
         err = {"probe": ("backend unavailable: "
                          + "; ".join(probe_errors))[:2000]}
         data = _read()
-        data.setdefault("decode", {}).setdefault("errors", []).append(err)
+        data.setdefault(key, {}).setdefault("errors", []).append(err)
         with open(ARTIFACT, "w") as f:
             json.dump(data, f, indent=1)
         print(json.dumps(err))
         return 1
+    if key == "serve":
+        # ONE child runs the continuous-vs-static A/B and emits one row
+        # holding both sides (same seeded arrivals)
+        def on_result(row, job, rows, errors):
+            _merge(rows, errors, key="serve")
+            print(json.dumps(row if row is not None else errors[-1]))
+
+        rows, errors = run_budgeted_jobs(
+            [{}], child_argv(os.path.abspath(__file__)) + ["--serve"],
+            lambda line: (json.loads(line[len(SENTINEL):])
+                          if line.startswith(SENTINEL) else None),
+            budget=budget, cap_s=CHILD_TIMEOUT_S,
+            env_base=dict(os.environ), on_result=on_result)
+        return 0 if rows and not errors else 1
     jobs = [  # MHA vs GQA x full vs rolling-window cache
         {"DTF_DEC_KV": "0", "DTF_DEC_WINDOW": "0"},
         {"DTF_DEC_KV": "4", "DTF_DEC_WINDOW": "0"},
@@ -176,6 +288,11 @@ def main():
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
-        child()
+        if "--serve" in sys.argv:
+            child_serve()
+        else:
+            child()
+    elif "--sweep-serve" in sys.argv:
+        sys.exit(main(key="serve"))
     else:
         sys.exit(main())

@@ -27,14 +27,14 @@ dflags.define_mesh_flags()
 flags.DEFINE_string("logdir", "/tmp/dtf_tpu_logs", "training logdir whose "
                     "ckpt/ subdir holds the checkpoint to serve")
 flags.DEFINE_string("size", "small", "small (gpt2-124M) | medium "
-                    "(gpt2-355M) | tiny — must match "
-                    "the trained config")
-flags.DEFINE_integer("kv_heads", 0, "grouped-query attention heads; must "
-                     "match the trained config (0 = plain MHA)")
-flags.DEFINE_integer("attn_window", 0, "sliding-window size; must match "
-                     "the trained config (0 = full causal)")
+                    "(gpt2-355M) | tiny — auto-loaded from the checkpoint "
+                    "manifest when present (a contradicting flag errors)")
+flags.DEFINE_integer("kv_heads", 0, "grouped-query attention heads "
+                     "(0 = plain MHA); manifest wins")
+flags.DEFINE_integer("attn_window", 0, "sliding-window size (0 = full "
+                     "causal); manifest wins")
 flags.DEFINE_integer("attn_global_every", 0, "global-attention layer "
-                     "cadence; must match the trained config")
+                     "cadence; manifest wins")
 flags.DEFINE_string("prompt", "", "comma-separated token ids; empty = a "
                     "fixed demo prompt")
 flags.DEFINE_integer("batch", 1, "decode batch size (prompt is broadcast)")
@@ -97,8 +97,19 @@ def main(argv):
         mesh = make_mesh(MeshConfig(data=dp, model=tp),
                          devices=jax.devices()[:dp * tp])
 
+    from dtf_tpu.checkpoint import load_model_config
+
+    # the config manifest train_gpt.py writes next to the Orbax dir is
+    # authoritative for the architecture fields; hand-matched flags only
+    # survive when they agree (a mismatch used to garble decode silently)
+    ckpt_dir = os.path.join(FLAGS.logdir, "ckpt")
     try:
-        base = gpt.GPTConfig.by_name(FLAGS.size)
+        decode_cfg = dflags.resolve_decode_config(
+            FLAGS, load_model_config(ckpt_dir))
+    except ValueError as e:
+        raise app.UsageError(str(e))
+    try:
+        base = gpt.GPTConfig.by_name(decode_cfg["size"])
     except KeyError as e:
         raise app.UsageError(f"--size: {e.args[0]}")
     prompt_ids = ([int(t) for t in FLAGS.prompt.split(",") if t.strip()]
@@ -107,23 +118,26 @@ def main(argv):
         raise app.UsageError(
             f"prompt ids must be in [0, {base.vocab_size})")
     total = len(prompt_ids) + FLAGS.n_new
-    if FLAGS.kv_cache_dtype not in ("", "int8"):
+    if decode_cfg["kv_cache_dtype"] not in ("", "int8"):
         raise app.UsageError(
-            f"--kv_cache_dtype={FLAGS.kv_cache_dtype!r}: '' or 'int8'")
-    cfg = dataclasses.replace(base, kv_heads=FLAGS.kv_heads or None,
-                              attn_window=FLAGS.attn_window,
-                              attn_global_every=FLAGS.attn_global_every,
-                              kv_cache_dtype=FLAGS.kv_cache_dtype,
+            f"--kv_cache_dtype={decode_cfg['kv_cache_dtype']!r}: "
+            "'' or 'int8'")
+    cfg = dataclasses.replace(base,
+                              kv_heads=decode_cfg["kv_heads"] or None,
+                              attn_window=decode_cfg["attn_window"],
+                              attn_global_every=decode_cfg[
+                                  "attn_global_every"],
+                              kv_cache_dtype=decode_cfg["kv_cache_dtype"],
                               decode_len=total)
     model = gpt.GPT(cfg)
 
-    ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"))
+    ckpt = Checkpointer(ckpt_dir)
     step = ckpt.latest_step()
     if step is None:
         raise app.UsageError(f"no checkpoint under {FLAGS.logdir}/ckpt")
-    # raw restore: pull params out of the saved TrainState without
-    # reconstructing the optimizer state's shapes
-    params = ckpt.restore_raw(step)["params"]
+    # params-only restore: new checkpoints carry a dedicated params item
+    # (no ~3x opt_state read); legacy ones fall back to the full-tree read
+    params = ckpt.restore_params(step)
     print(f"restored checkpoint step {step} from {FLAGS.logdir}/ckpt",
           file=sys.stderr)
 

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""GPT online serving: continuous-batching decode over a train_gpt checkpoint.
+
+    # explicit requests (semicolon-separated prompts)
+    python scripts/serve_gpt.py --logdir=/tmp/dtf_tpu_logs \
+        --requests="12,7,99;5,6,7,8" --n_new=32 --emit_tokens
+
+    # seeded Poisson load (benching)
+    python scripts/serve_gpt.py --logdir=/tmp/dtf_tpu_logs \
+        --poisson_rate=4 --n_requests=32 --max_len=256
+
+The online half of the flagship loop (scripts/generate_gpt.py is the
+offline half): restores PARAMS ONLY from the Orbax checkpoint
+(``Checkpointer.restore_params`` — no ~3x opt_state read), auto-loads the
+architecture manifest train_gpt.py wrote (hand-matched flags are verified
+against it, not trusted), builds a :class:`dtf_tpu.serve.DecodeEngine`
+(``--n_slots`` concurrent requests, ``--max_len`` per-slot budget) and
+pumps a FIFO scheduler with prefill/decode interleave. Prints ONE JSON
+line of serving metrics (bench.py idiom): tokens/sec, TTFT p50/p99,
+per-token latency, occupancy, queue depth. ``--emit_tokens`` additionally
+prints one ``rid:tok,tok,...`` row per completed request.
+
+Sharded serving is opt-in like generate_gpt.py: ``--mesh_data``/
+``--mesh_model`` place the KV cache P('data','model') on a device subset
+(slots over data shards, heads over TP shards).
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from absl import app, flags
+
+from dtf_tpu.cli import flags as dflags
+
+dflags.define_cluster_flags()
+dflags.define_mesh_flags()
+flags.DEFINE_string("logdir", "/tmp/dtf_tpu_logs", "training logdir whose "
+                    "ckpt/ subdir holds the checkpoint to serve")
+flags.DEFINE_string("size", "small", "small | medium | tiny; auto-loaded "
+                    "from the checkpoint manifest when present")
+flags.DEFINE_integer("kv_heads", 0, "grouped-query heads (manifest wins)")
+flags.DEFINE_integer("attn_window", 0, "sliding window (manifest wins)")
+flags.DEFINE_integer("attn_global_every", 0, "global-layer cadence "
+                     "(manifest wins)")
+flags.DEFINE_string("kv_cache_dtype", "", "'' or 'int8' (serving-side "
+                    "choice; halves the cache bytes)")
+flags.DEFINE_integer("n_slots", 8, "concurrent request slots (the KV "
+                     "cache batch dimension)")
+flags.DEFINE_integer("max_len", 256, "per-slot token budget "
+                     "(prompt + generated)")
+flags.DEFINE_integer("prefill_chunk", 16, "fixed width of the prefill "
+                     "program (>= 2); long prompts stream through it")
+flags.DEFINE_integer("prefill_chunks_per_tick", 4, "prefill/decode "
+                     "interleave: at most this many prompt chunks between "
+                     "decode steps (0 = admit greedily)")
+flags.DEFINE_string("requests", "", "semicolon-separated comma-lists of "
+                    "token ids; empty = Poisson load")
+flags.DEFINE_integer("n_new", 32, "max new tokens per explicit request")
+flags.DEFINE_float("temperature", 0.0, "0 = greedy, else sampling")
+flags.DEFINE_integer("top_k", 0, "top-k filter (0 = off)")
+flags.DEFINE_float("top_p", 1.0, "nucleus filter (1.0 = off)")
+flags.DEFINE_integer("eos_id", -1, "stop token (-1 = none)")
+flags.DEFINE_integer("pad_id", 0, "pad token after eos")
+flags.DEFINE_integer("seed", 0, "sampling / load-gen PRNG seed")
+flags.DEFINE_float("poisson_rate", 2.0, "requests per second for the "
+                   "seeded open-loop load generator")
+flags.DEFINE_integer("n_requests", 16, "Poisson-mode request count")
+flags.DEFINE_integer("prompt_min", 4, "Poisson-mode min prompt length")
+flags.DEFINE_integer("prompt_max", 64, "Poisson-mode max prompt length")
+flags.DEFINE_integer("new_min", 8, "Poisson-mode min new tokens")
+flags.DEFINE_integer("new_max", 64, "Poisson-mode max new tokens")
+flags.DEFINE_boolean("emit_tokens", False, "print rid:tok,... per request")
+FLAGS = flags.FLAGS
+
+
+def main(argv):
+    del argv
+    import jax
+
+    from dtf_tpu.checkpoint import Checkpointer, load_model_config
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.core.sharding import shard_tree
+    from dtf_tpu.metrics import MetricWriter
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve import (DecodeEngine, PoissonLoadGen, Request,
+                               Scheduler, replay)
+
+    if FLAGS.backend == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    sharded = FLAGS.mesh_model > 1 or FLAGS.mesh_data > 1
+    mesh = None
+    if sharded:
+        dp = max(FLAGS.mesh_data, 1)
+        tp = max(FLAGS.mesh_model, 1)
+        if dp * tp > len(jax.devices()):
+            raise app.UsageError(
+                f"mesh {dp}x{tp} exceeds {len(jax.devices())} devices")
+        if FLAGS.n_slots % dp:
+            raise app.UsageError(
+                f"--n_slots={FLAGS.n_slots} not divisible by the data "
+                f"axis ({dp}) — slots shard over 'data'")
+        mesh = make_mesh(MeshConfig(data=dp, model=tp),
+                         devices=jax.devices()[:dp * tp])
+
+    ckpt_dir = os.path.join(FLAGS.logdir, "ckpt")
+    try:
+        decode_cfg = dflags.resolve_decode_config(
+            FLAGS, load_model_config(ckpt_dir))
+    except ValueError as e:
+        raise app.UsageError(str(e))
+    try:
+        base = gpt.GPTConfig.by_name(decode_cfg["size"])
+    except KeyError as e:
+        raise app.UsageError(f"--size: {e.args[0]}")
+    if decode_cfg["kv_cache_dtype"] not in ("", "int8"):
+        raise app.UsageError(
+            f"--kv_cache_dtype={decode_cfg['kv_cache_dtype']!r}: "
+            "'' or 'int8'")
+    cfg = dataclasses.replace(base,
+                              kv_heads=decode_cfg["kv_heads"] or None,
+                              attn_window=decode_cfg["attn_window"],
+                              attn_global_every=decode_cfg[
+                                  "attn_global_every"],
+                              kv_cache_dtype=decode_cfg["kv_cache_dtype"])
+
+    ckpt = Checkpointer(ckpt_dir)
+    step = ckpt.latest_step()
+    if step is None:
+        raise app.UsageError(f"no checkpoint under {ckpt_dir}")
+    params = ckpt.restore_params(step)
+    print(f"restored params of step {step} from {ckpt_dir}",
+          file=sys.stderr)
+    if sharded:
+        params = shard_tree(params, mesh, gpt.tp_rules)
+
+    try:
+        engine = DecodeEngine(cfg, params, n_slots=FLAGS.n_slots,
+                              max_len=FLAGS.max_len,
+                              prefill_chunk=FLAGS.prefill_chunk, mesh=mesh)
+    except ValueError as e:     # n_slots/max_len/prefill_chunk flag errors
+        raise app.UsageError(str(e))
+    writer = MetricWriter(None, also_log=False)
+    sched = Scheduler(
+        engine, writer, log_every=0,
+        prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
+
+    eos = FLAGS.eos_id if FLAGS.eos_id >= 0 else None
+    t0 = time.perf_counter()
+    rids = []
+    if FLAGS.requests:
+        for i, row in enumerate(r for r in FLAGS.requests.split(";") if r):
+            prompt = [int(t) for t in row.split(",") if t.strip()]
+            if not prompt or not all(
+                    0 <= t < cfg.vocab_size for t in prompt):
+                raise app.UsageError(
+                    f"request {i}: token ids must be in "
+                    f"[0, {cfg.vocab_size})")
+            try:
+                rids.append(sched.submit(Request(
+                    prompt=prompt, max_new=FLAGS.n_new,
+                    temperature=FLAGS.temperature, top_k=FLAGS.top_k,
+                    top_p=FLAGS.top_p, eos_id=eos, pad_id=FLAGS.pad_id,
+                    seed=FLAGS.seed + i)))
+            except ValueError as e:   # over-long prompt / bad n_new
+                raise app.UsageError(f"request {i}: {e}")
+        sched.run_until_idle()
+    else:
+        prompt_cap = min(FLAGS.prompt_max, FLAGS.max_len - FLAGS.new_min)
+        if prompt_cap < FLAGS.prompt_min:
+            raise app.UsageError(
+                f"--max_len={FLAGS.max_len} leaves no room for prompts in "
+                f"[{FLAGS.prompt_min}, ..] plus --new_min={FLAGS.new_min}; "
+                "raise --max_len or lower --prompt_min/--new_min")
+        try:
+            gen = PoissonLoadGen(
+                rate=FLAGS.poisson_rate, n_requests=FLAGS.n_requests,
+                vocab_size=cfg.vocab_size, prompt_min=FLAGS.prompt_min,
+                prompt_max=prompt_cap,
+                new_min=FLAGS.new_min, new_max=FLAGS.new_max,
+                temperature=FLAGS.temperature, top_k=FLAGS.top_k,
+                top_p=FLAGS.top_p, eos_id=eos, seed=FLAGS.seed)
+        except ValueError as e:  # rate/prompt/new bound flag errors
+            raise app.UsageError(str(e))
+        replay(sched, gen.arrivals())
+        rids = list(range(FLAGS.n_requests))   # submit order = id order
+    wall = time.perf_counter() - t0
+
+    if FLAGS.emit_tokens:
+        for rid in rids:
+            st = sched.poll(rid)
+            print(f"{rid}:" + ",".join(str(t) for t in st["tokens"]))
+    n_tokens = sum(len(sched.poll(r)["tokens"]) for r in rids)
+    out = {"mode": "requests" if FLAGS.requests else "poisson",
+           "backend": jax.default_backend(), "step": step,
+           "n_slots": FLAGS.n_slots, "max_len": FLAGS.max_len,
+           "prefill_chunk": FLAGS.prefill_chunk,
+           "requests": len(rids), "generated_tokens": n_tokens,
+           "wall_s": round(wall, 4),
+           "tokens_per_sec": round(n_tokens / max(wall, 1e-9), 1),
+           "cache_mib": round(engine.cache_bytes() / 2 ** 20, 2)}
+    out.update({k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in sched.stats().items()})
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    app.run(main)

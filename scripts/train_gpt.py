@@ -307,6 +307,18 @@ def main(argv):
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
+    # architecture manifest next to the Orbax dir: generate_gpt.py /
+    # serve_gpt.py auto-load it instead of trusting hand-matched --size
+    # flags (a mismatch used to garble decode silently)
+    from dtf_tpu.checkpoint import save_model_config
+
+    save_model_config(ckpt.directory, {
+        "model": "gpt", "size": FLAGS.size,
+        "kv_heads": FLAGS.kv_heads, "attn_window": FLAGS.attn_window,
+        "attn_global_every": FLAGS.attn_global_every,
+        "moe_every": FLAGS.moe_every, "vocab_size": cfg.vocab_size,
+        "d_model": cfg.d_model, "layers": cfg.layers, "heads": cfg.heads,
+        "d_ff": cfg.d_ff, "kv_cache_dtype": ""})
     place_batch = lambda b: shard_batch(  # noqa: E731
         gpt.zigzag_batch(b, mesh.shape["seq"])
         if (sp and FLAGS.attn_impl == "zigzag") else b,

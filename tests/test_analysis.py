@@ -235,9 +235,12 @@ def test_shipping_config_jaxpr_clean(name):
 GOLDEN = runner.golden_path()
 # bert_accum/bert_grad_shard ride the fast tier so the --grad_shard
 # reduce-scatter swap AND its accumulator temp-bytes fence fail in tier-1
-# (ISSUE 3; docs/ZERO.md).
+# (ISSUE 3; docs/ZERO.md). gpt_serve rides it so the SERVING decode
+# graph's collectives (dtf_tpu/serve; docs/SERVING.md) are fenced in
+# tier-1 too — decode is a per-token hot path, an accidental cache
+# resharding there is worse than one in a train step.
 FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert", "bert_accum",
-                       "bert_grad_shard"]
+                       "bert_grad_shard", "gpt_serve"]
 
 
 @pytest.mark.parametrize("name", FAST_BUDGET_CONFIGS)
@@ -250,7 +253,9 @@ def test_comms_budget_matches_golden(name):
     findings = hlo.check_budget(budget, golden["budgets"][name],
                                 config=name)
     assert not findings, findings
-    # DP gradient mean must ride an all-reduce in every train step
+    # every fast-tier graph moves data over the mesh: the DP gradient
+    # mean in the train steps, the TP row-parallel projections in the
+    # gpt_serve decode step — all spelled all-reduce
     assert budget["all-reduce"]["count"] > 0
 
 

@@ -161,6 +161,116 @@ def test_restore_missing_raises(mesh8, tmp_path):
     assert restored is None and same is state
 
 
+def test_params_item_saved_and_restored(mesh8, tmp_path):
+    """The serving-restore satellite: saves write a dedicated params item
+    next to the full state, and restore_params reads ONLY it (no
+    opt_state bytes); legacy single-item checkpoints fall back to the
+    full-tree read."""
+    import os
+
+    import orbax.checkpoint as ocp
+
+    state, step = build(mesh8)
+    state, _ = step(state, make_batch(seed=0))
+    ckpt = Checkpointer(tmp_path / "two", async_save=False)
+    ckpt.save(2, state, force=True)
+    ckpt.wait()
+    # layout: a params item exists on disk next to the state item
+    assert os.path.isdir(tmp_path / "two" / "2" / "params")
+    assert os.path.isdir(tmp_path / "two" / "2" / "state")
+    params = ckpt.restore_params()
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), params,
+        jax.tree.map(np.asarray, state.params))
+    # the params item alone carries no optimizer bytes
+    assert "opt_state" not in params
+
+    # legacy layout (pre-params-item checkpoint) → restore_raw fallback
+    mgr = ocp.CheckpointManager(
+        os.fspath(tmp_path / "legacy"),
+        options=ocp.CheckpointManagerOptions(
+            enable_async_checkpointing=False))
+    mgr.save(1, args=ocp.args.StandardSave(
+        {"params": {"w": np.ones((3,), np.float32)},
+         "opt_state": {"m": np.zeros(3, np.float32)}}))
+    mgr.wait_until_finished()
+    mgr.close()
+    old = Checkpointer(tmp_path / "legacy")
+    p = old.restore_params()
+    np.testing.assert_array_equal(np.asarray(p["w"]), np.ones(3))
+    raw = old.restore_raw()
+    assert set(raw) == {"params", "opt_state"}
+
+
+def test_model_config_manifest_roundtrip(tmp_path):
+    from dtf_tpu.checkpoint import load_model_config, save_model_config
+
+    assert load_model_config(tmp_path) is None
+    save_model_config(tmp_path, {"size": "tiny", "kv_heads": 2,
+                                 "attn_window": 8})
+    m = load_model_config(tmp_path)
+    assert m == {"size": "tiny", "kv_heads": 2, "attn_window": 8}
+    # corrupt manifest degrades to None (flags fallback), not a crash
+    with open(tmp_path / "model_config.json", "w") as f:
+        f.write("{nope")
+    assert load_model_config(tmp_path) is None
+
+
+class _FakeFlag:
+    def __init__(self, value, present):
+        self.value, self.present = value, present
+
+
+class _FakeFlags:
+    """Duck-typed absl FLAGS: attribute access → value, item access →
+    the flag object with .present (what resolve_decode_config reads)."""
+
+    def __init__(self, **kw):
+        object.__setattr__(self, "_d",
+                           {k: _FakeFlag(v, p) for k, (v, p) in kw.items()})
+
+    def __getattr__(self, k):
+        return self._d[k].value
+
+    def __getitem__(self, k):
+        return self._d[k]
+
+
+def test_resolve_decode_config_manifest_merge():
+    """Manifest satellite: unset flags follow the manifest, matching
+    explicit flags pass, contradicting ones raise, MoE checkpoints are
+    rejected (no decode path), kv_cache_dtype stays a serving-side
+    choice."""
+    from dtf_tpu.cli.flags import resolve_decode_config
+
+    def flags(**over):
+        base = dict(size=("small", False), kv_heads=(0, False),
+                    attn_window=(0, False), attn_global_every=(0, False),
+                    kv_cache_dtype=("", False))
+        base.update(over)
+        return _FakeFlags(**base)
+
+    manifest = {"size": "tiny", "kv_heads": 2, "attn_window": 8,
+                "attn_global_every": 2, "moe_every": 0,
+                "kv_cache_dtype": ""}
+    got = resolve_decode_config(flags(), manifest)
+    assert got == {"size": "tiny", "kv_heads": 2, "attn_window": 8,
+                   "attn_global_every": 2, "kv_cache_dtype": ""}
+    # no manifest → flags pass through (old checkpoints keep working)
+    got = resolve_decode_config(flags(size=("medium", True)), None)
+    assert got["size"] == "medium"
+    # explicit matching flag is fine; contradicting one raises
+    resolve_decode_config(flags(kv_heads=(2, True)), manifest)
+    with pytest.raises(ValueError, match="contradicts"):
+        resolve_decode_config(flags(kv_heads=(4, True)), manifest)
+    # kv_cache_dtype: flag wins, manifest is only a default
+    got = resolve_decode_config(flags(kv_cache_dtype=("int8", True)),
+                                manifest)
+    assert got["kv_cache_dtype"] == "int8"
+    with pytest.raises(ValueError, match="MoE"):
+        resolve_decode_config(flags(), dict(manifest, moe_every=2))
+
+
 def test_eval_hook_runs_and_averages(mesh8):
     from dtf_tpu.core.comms import shard_batch
     from tests.test_train import linear_eval

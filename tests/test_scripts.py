@@ -259,6 +259,73 @@ def test_bench_decode_child_tiny_mode(kv, window, chunk):
     assert row["prefill_chunk"] == int(chunk)
 
 
+def test_bench_decode_serve_ab_child_tiny_mode():
+    """The continuous-vs-static A/B child (--sweep-serve): one row with
+    both sides' goodput and TTFT percentiles, on the CPU sim."""
+    env = _env()
+    env.update(DTF_DECODE_TINY="1", DTF_SERVE_RATE="500", DTF_SERVE_N="8")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_decode.py"),
+         "--child", "--serve"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    import json
+
+    rows = [json.loads(ln[len("BENCH_DECODE_ROW "):])
+            for ln in proc.stdout.splitlines()
+            if ln.startswith("BENCH_DECODE_ROW ")]
+    assert len(rows) == 1
+    row = rows[0]
+    for side in ("serve", "static"):
+        assert row[side]["tokens_per_sec"] > 0
+        assert row[side]["ttft_p50_s"] <= row[side]["ttft_p99_s"]
+    assert 0 < row["serve"]["occupancy_mean"] <= 1
+
+
+def test_serve_launcher_round_trip(tmp_path):
+    """train_gpt → serve_gpt: the online half of the flagship loop. The
+    launcher restores the params-only item, auto-loads the manifest (no
+    --size passed!), serves explicit requests and a Poisson burst, and its
+    greedy tokens for a shared prompt match generate_gpt.py's."""
+    out = _run("train_gpt.py", "--size=tiny", "--train_steps=2",
+               "--batch_size=16", "--seq_len=32", "--checkpoint_every=2",
+               f"--logdir={tmp_path}")
+    assert "done: step=2" in out
+    assert (tmp_path / "ckpt" / "model_config.json").exists()
+
+    srv = _run("serve_gpt.py", f"--logdir={tmp_path}", "--n_slots=2",
+               "--max_len=48", "--prefill_chunk=4",
+               "--requests=5,9,2;1,2,3,4,5,6", "--n_new=6", "--emit_tokens")
+    import json
+
+    line = [ln for ln in srv.splitlines() if ln.startswith("{")][-1]
+    stats = json.loads(line)
+    assert stats["requests"] == 2 and stats["serve_completed"] == 2.0
+    assert stats["tokens_per_sec"] > 0
+    srv_row = [ln for ln in srv.splitlines() if ln.startswith("0:")][0]
+
+    gen = _run("generate_gpt.py", f"--logdir={tmp_path}",
+               "--prompt=5,9,2", "--n_new=6")
+    gen_row = [ln for ln in gen.splitlines() if ln.startswith("5,9,2,")][0]
+    # same checkpoint, same greedy prompt → same continuation
+    assert gen_row == "5,9,2," + srv_row[len("0:"):]
+
+    # a flag contradicting the manifest must fail loudly, not garble decode
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "serve_gpt.py"),
+         f"--logdir={tmp_path}", "--size=small"],
+        env=_env(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0 and "contradicts" in proc.stderr
+
+    srv_p = _run("serve_gpt.py", f"--logdir={tmp_path}", "--n_slots=2",
+                 "--max_len=48", "--prefill_chunk=4", "--poisson_rate=500",
+                 "--n_requests=6", "--prompt_min=2", "--prompt_max=10",
+                 "--new_min=2", "--new_max=8")
+    stats = json.loads([ln for ln in srv_p.splitlines()
+                        if ln.startswith("{")][-1])
+    assert stats["mode"] == "poisson" and stats["serve_completed"] == 6.0
+
+
 def test_generate_rejects_sampling_flags_at_greedy(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "scripts", "generate_gpt.py"),

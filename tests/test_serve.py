@@ -1,0 +1,307 @@
+"""Continuous-batching serve engine (dtf_tpu/serve): engine/offline bitwise
+parity under churn, slot reuse/eviction, the steady-state recompile fence,
+prefill/decode interleave safety, and sharded serving."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.models import gpt
+from dtf_tpu.serve import (DecodeEngine, PoissonLoadGen, Request, Scheduler,
+                           ServeClient)
+
+CFG = gpt.GPTConfig.tiny(dtype=jnp.float32)
+MAX_LEN = 48
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = gpt.GPT(dataclasses.replace(CFG, decode_len=MAX_LEN))
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 1), jnp.int32))["params"]
+
+
+@pytest.fixture(scope="module")
+def engine(params):
+    """One engine shared by the read-only parity tests: construction AOT
+    compiles the two programs; slot churn must never add a third."""
+    return DecodeEngine(CFG, params, n_slots=4, max_len=MAX_LEN,
+                        prefill_chunk=5)
+
+
+def _offline(params, req: dict, eos_id=None) -> list[int]:
+    """The per-request reference: batch-1 offline generate() with the same
+    sampling params and seed, truncated the way the engine terminates
+    (through the first eos, else max_new)."""
+    model = gpt.GPT(dataclasses.replace(CFG, decode_len=MAX_LEN))
+    out = gpt.generate(
+        model, params, jnp.asarray([req["prompt"]], jnp.int32),
+        req["max_new"], rng=jax.random.PRNGKey(req.get("seed", 0)),
+        temperature=req.get("temperature", 0.0),
+        top_k=req.get("top_k", 0), top_p=req.get("top_p", 1.0),
+        eos_id=eos_id)
+    toks = np.asarray(out)[0, len(req["prompt"]):].tolist()
+    if eos_id is not None and eos_id in toks:
+        toks = toks[:toks.index(eos_id) + 1]
+    return toks
+
+
+def test_engine_offline_parity_mixed_churn(params, engine):
+    """THE acceptance property: a mixed-length request set (greedy and
+    seeded sampling, more requests than slots, prompts spanning several
+    ragged chunk counts) decodes token-for-token identically to per-request
+    offline generate() — and steady state traces nothing new."""
+    rng = np.random.default_rng(0)
+    reqs = []
+    for i in range(7):
+        t_p = int(rng.integers(1, 20))
+        reqs.append(dict(
+            prompt=rng.integers(0, CFG.vocab_size, t_p).tolist(),
+            max_new=int(rng.integers(1, 16)),
+            temperature=0.0 if i % 2 == 0 else 0.9,
+            top_k=0 if i < 4 else 3, top_p=1.0 if i % 3 else 0.9,
+            seed=100 + i))
+    client = ServeClient(engine)
+    rids = [client.submit(**r) for r in reqs]
+    client.drain()
+    for r, rid in zip(reqs, rids):
+        assert client.result(rid) == _offline(params, r), r
+    assert engine.trace_counts == {"prefill": 1, "decode": 1}
+
+
+def test_recompile_fence_steady_state(params):
+    """Exactly the prefill+decode compilations exist; request churn through
+    slots (fresh shapes of everything BUT the programs: prompt lengths,
+    sampling params, eos, chunk counts) triggers zero retraces — and zero
+    backend compiles where jax.monitoring can see them."""
+    events = []
+    mon = getattr(jax, "monitoring", None)
+    if mon is not None and hasattr(mon, "register_event_listener"):
+        mon.register_event_listener(
+            lambda name, *a, **kw: events.append(name))
+
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=4)
+    assert eng.trace_counts == {"prefill": 1, "decode": 1}
+    sched = Scheduler(eng, None, prefill_chunks_per_tick=1)
+    # one warm lap first: host-side helpers (PRNGKey seeding etc.) may
+    # compile tiny ops once per process — that is startup, not steady state
+    sched.submit(Request(prompt=[1, 2, 3], max_new=2))
+    sched.run_until_idle()
+    baseline = len([e for e in events if "compil" in e])
+
+    rng = np.random.default_rng(1)
+    for i in range(6):
+        t_p = int(rng.integers(1, 20))
+        sched.submit(Request(
+            prompt=rng.integers(0, CFG.vocab_size, t_p).tolist(),
+            max_new=int(rng.integers(1, 10)),
+            temperature=float(i % 2), top_k=i, eos_id=i if i % 2 else None,
+            seed=i))
+    sched.run_until_idle()
+    assert eng.trace_counts == {"prefill": 1, "decode": 1}
+    steady = len([e for e in events if "compil" in e])
+    if baseline:   # listener demonstrably observes compiles → assert flat
+        assert steady == baseline, (
+            f"{steady - baseline} backend compiles during steady-state "
+            "churn")
+
+
+def test_eos_eviction_and_slot_reuse(params):
+    """EOS evicts mid-stream and the freed slot is reused: with a 2-slot
+    engine and 5 requests (one eos'd early), everything completes, each
+    request matches its offline reference, and termination is by eos
+    exactly where offline emits it."""
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=5)
+    client = ServeClient(eng)
+    base = dict(prompt=[5, 9, 2, 44], max_new=12)
+    free = _offline(params, base)
+    eos = free[2]                     # the third greedy token stops row 0
+    reqs = [dict(base), dict(prompt=[7, 7], max_new=9, temperature=0.8,
+                             seed=3),
+            dict(prompt=[1, 2, 3, 4, 5, 6, 7], max_new=6),
+            dict(prompt=[9], max_new=4, temperature=1.1, top_p=0.8,
+                 seed=11),
+            dict(prompt=[3, 1, 4, 1, 5], max_new=8)]
+    rids = [client.submit(**reqs[0], eos_id=eos)]
+    rids += [client.submit(**r) for r in reqs[1:]]
+    client.drain()
+    got0 = client.result(rids[0])
+    # the engine stops AT the first eos, exactly where offline emits it
+    assert got0 == _offline(params, base, eos_id=eos), (got0, free)
+    assert got0[-1] == eos and len(got0) < base["max_new"]
+    occupied = client.stats()["serve_occupancy"]
+    assert occupied == 0.0                          # every slot freed
+    for r, rid in zip(reqs[1:], rids[1:]):
+        assert client.result(rid) == _offline(params, r), r
+
+
+def test_interleaved_prefill_does_not_corrupt_running_slots(params):
+    """The mid-prefill spectator contract: with prefill_chunks_per_tick=1
+    a long prompt spreads over many ticks while other slots decode between
+    its chunks — the active mask must keep BOTH the running slots and the
+    half-prefilled slot bit-exact vs offline."""
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=3)
+    sched = Scheduler(eng, None, prefill_chunks_per_tick=1)
+    short = dict(prompt=[11, 22, 33], max_new=14, temperature=0.7, seed=5)
+    long = dict(prompt=list(range(1, 20)), max_new=10)   # 7 ragged chunks
+    r1 = sched.submit(Request(**short))
+    sched.tick()                                    # short admitted, runs
+    r2 = sched.submit(Request(**long))              # prefills 1 chunk/tick
+    sched.run_until_idle()
+    assert sched.poll(r1)["tokens"] == _offline(params, short)
+    assert sched.poll(r2)["tokens"] == _offline(params, long)
+
+
+def test_engine_parity_with_rolling_window_and_int8(params):
+    """The cache variants compose: a windowed int8 engine decodes exactly
+    like offline generate() with the SAME chunked prefill (chunk-aligned
+    prompt, so both sides run identical chunk boundaries)."""
+    cfg = dataclasses.replace(
+        gpt.GPTConfig.tiny(dtype=jnp.float32, kv_heads=2, attn_window=8),
+        kv_cache_dtype="int8")
+    model = gpt.GPT(dataclasses.replace(cfg, decode_len=MAX_LEN))
+    params8 = model.init(jax.random.PRNGKey(1),
+                         jnp.zeros((1, 1), jnp.int32))["params"]
+    eng = DecodeEngine(cfg, params8, n_slots=3, max_len=MAX_LEN,
+                       prefill_chunk=5)
+    client = ServeClient(eng)
+    prompt = list(np.random.default_rng(2).integers(0, 128, 10))  # 2 chunks
+    rid = client.submit(prompt, max_new=8)
+    got = client.result(rid)
+    want = gpt.generate(model, params8, jnp.asarray([prompt], jnp.int32),
+                        8, prefill_chunk=5)
+    assert got == np.asarray(want)[0, len(prompt):].tolist()
+
+
+def test_engine_sharded_matches_unsharded(params):
+    """dp2 x tp2 serving (cache P('data','model'), TP-sharded params)
+    produces the exact tokens of the single-device engine."""
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.core.sharding import shard_tree
+
+    mesh = make_mesh(MeshConfig(data=2, model=2),
+                     devices=jax.devices()[:4])
+    sharded = shard_tree(params, mesh, gpt.tp_rules)
+    eng_s = DecodeEngine(CFG, sharded, n_slots=4, max_len=MAX_LEN,
+                         prefill_chunk=5, mesh=mesh)
+    eng = DecodeEngine(CFG, params, n_slots=4, max_len=MAX_LEN,
+                       prefill_chunk=5)
+    reqs = [dict(prompt=[5, 9, 2], max_new=8),
+            dict(prompt=list(range(1, 13)), max_new=6, temperature=0.9,
+                 seed=7)]
+    outs = []
+    for e in (eng, eng_s):
+        client = ServeClient(e)
+        rids = [client.submit(**r) for r in reqs]
+        client.drain()
+        outs.append([client.result(r) for r in rids])
+    assert outs[0] == outs[1]
+
+
+def test_scheduler_fifo_metrics_and_queue(params, engine):
+    """Queue accounting: with 1-slot worth of work in flight the later
+    submissions wait FIFO; stats track completion/queue peak; a fake clock
+    makes TTFT deterministic."""
+    t = [0.0]
+    eng = DecodeEngine(CFG, params, n_slots=1, max_len=MAX_LEN,
+                       prefill_chunk=5)
+    sched = Scheduler(eng, None, clock=lambda: t[0])
+    ra = sched.submit(Request(prompt=[1, 2], max_new=3))
+    rb = sched.submit(Request(prompt=[3, 4], max_new=2))
+    assert sched.pending == 2
+    t[0] = 1.0
+    sched.run_until_idle()
+    st = sched.stats()
+    assert st["serve_completed"] == 2.0
+    assert st["serve_queue_peak"] == 2.0
+    assert sched.poll(ra)["status"] == "done"
+    assert len(sched.poll(ra)["tokens"]) == 3
+    assert len(sched.poll(rb)["tokens"]) == 2
+    assert st["serve_ttft_p50_s"] is not None
+
+
+def test_poisson_load_gen_deterministic():
+    gen = PoissonLoadGen(rate=10.0, n_requests=5, vocab_size=128, seed=4)
+    a, b = list(gen.arrivals()), list(gen.arrivals())
+    assert [t for t, _ in a] == [t for t, _ in b]
+    assert [r.prompt for _, r in a] == [r.prompt for _, r in b]
+    assert all(1 <= len(r.prompt) <= 64 for _, r in a)
+    assert sorted(t for t, _ in a) == [t for t, _ in a]   # ordered arrivals
+    # degenerate bounds fail at construction, not mid-replay inside numpy
+    with pytest.raises(ValueError, match="rate"):
+        PoissonLoadGen(rate=0.0, n_requests=1, vocab_size=128)
+    with pytest.raises(ValueError, match="new_min"):
+        PoissonLoadGen(rate=1.0, n_requests=1, vocab_size=128, new_min=0)
+    with pytest.raises(ValueError, match="prompt_min"):
+        PoissonLoadGen(rate=1.0, n_requests=1, vocab_size=128,
+                       prompt_min=8, prompt_max=4)
+
+
+def test_replay_pump_and_completed_cap(params):
+    """The shared open-loop pump (serve_gpt + bench A/B) drains a seeded
+    arrival stream; completed-record retention is bounded (release() and
+    the completed_cap both forget finished requests without touching live
+    accounting)."""
+    from dtf_tpu.serve import replay
+
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=5)
+    sched = Scheduler(eng, None, completed_cap=2)
+    gen = PoissonLoadGen(rate=1000.0, n_requests=5, vocab_size=128,
+                         prompt_min=2, prompt_max=10, new_min=2, new_max=6,
+                         seed=9)
+    wall = replay(sched, gen.arrivals())
+    assert wall > 0 and sched.pending == 0
+    assert sched.stats()["serve_completed"] == 5.0
+    # only the cap'd tail of completed records is still pollable
+    pollable = [r for r in range(5)
+                if r in sched._recs]
+    assert len(pollable) == 2
+    sched.release(pollable[-1])
+    assert pollable[-1] not in sched._recs
+
+
+def test_engine_and_config_validation(params):
+    with pytest.raises(ValueError, match="prefill_chunk"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=16, prefill_chunk=1)
+    with pytest.raises(ValueError, match="max_len"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=1)
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=16, prefill_chunk=4)
+    with pytest.raises(ValueError, match="prompt length"):
+        eng.prefill(0, list(range(16)))            # no room to generate
+    with pytest.raises(ValueError, match="slot"):
+        eng.prefill(5, [1, 2])
+    # a right-padded chunk wider than the cache would drop valid prompt
+    # K/V (the write window keeps only the last cache_len chunk positions)
+    with pytest.raises(ValueError, match="cache length"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=16, prefill_chunk=32)
+    with pytest.raises(ValueError, match="cache length"):
+        DecodeEngine(gpt.GPTConfig.tiny(dtype=jnp.float32, attn_window=8),
+                     params, n_slots=2, max_len=48, prefill_chunk=16)
+    # slot_decode config invariants fire at construction, not first trace
+    with pytest.raises(ValueError, match="slot_decode"):
+        gpt.GPTConfig.tiny(slot_decode=True)
+    with pytest.raises(ValueError, match="slot_decode"):
+        gpt.GPTConfig.tiny(slot_decode=True, decode_len=8,
+                           chunked_prefill=True)
+
+
+def test_filter_logits_dynamic_matches_static():
+    """The per-slot (traced k/p) filter is bit-equal to the static filter
+    generate() uses, across the on/off gates — the parity contract's
+    foundation."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(3, 16)).astype(np.float32))
+    for tk, tp in [(0, 1.0), (4, 1.0), (0, 0.7), (4, 0.7), (1, 1e-9),
+                   (99, 0.5)]:
+        want = gpt.filter_logits(logits, top_k=tk, top_p=tp)
+        got = gpt.filter_logits_dynamic(logits, top_k=jnp.int32(tk),
+                                        top_p=jnp.float32(tp))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want),
+                                      err_msg=f"top_k={tk} top_p={tp}")
