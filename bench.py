@@ -69,6 +69,7 @@ def child():
     from dtf_tpu.core.mesh import make_mesh
     from dtf_tpu.models import resnet
 
+    t_child0 = time.perf_counter()
     batch = int(os.environ.get("DTF_BENCH_BATCH", "128"))
     mesh = make_mesh()
     n_chips = mesh.devices.size
@@ -88,9 +89,11 @@ def child():
 
     # warmup (compile + 2 steps); fence via a value readback — on the
     # experimental axon plugin block_until_ready alone proved unreliable.
+    t_warm0 = time.perf_counter()
     for _ in range(3):
         state, metrics = step(state, data)
     float(metrics["loss"])
+    warmup_s = time.perf_counter() - t_warm0
 
     n_steps = 20
     t0 = time.perf_counter()
@@ -102,6 +105,10 @@ def child():
     img_s = batch * n_steps / dt
     img_s_chip = img_s / n_chips
     mfu = img_s_chip * RESNET50_TRAIN_FLOPS_PER_IMG / V5E_PEAK_BF16_FLOPS
+    # goodput accounting (docs/OBSERVABILITY.md): productive = the timed
+    # measurement loop; warmup (compile + settle) and state/data setup are
+    # the overhead buckets of this process's wall clock so far.
+    total_s = time.perf_counter() - t_child0
     out = {
         "metric": METRIC,
         "value": round(img_s_chip, 2),
@@ -110,6 +117,13 @@ def child():
         "mfu": round(mfu, 4),
         "backend": jax.default_backend(),
         "n_chips": n_chips,
+        "goodput": round(dt / max(total_s, 1e-9), 4),
+        "goodput_buckets": {
+            "productive_s": round(dt, 3),
+            "compile_warmup_s": round(warmup_s, 3),
+            "setup_s": round(max(total_s - dt - warmup_s, 0.0), 3),
+            "total_s": round(total_s, 3),
+        },
     }
     # Roofline context (PERF.md §1): XLA's own FLOP/byte counts show this
     # model runs AT the v5e HBM-bandwidth roofline — mfu_xla and the
@@ -228,6 +242,18 @@ def _attach_companion_metrics(result: dict) -> None:
     for row in rows_of("ATTN_BENCH.json", "tpu", "rows"):
         if row.get("seq") == 8192 and "fwd_speedup" in row:
             result["flash_vs_dense_fwd_8k"] = row["fwd_speedup"]
+    tel_rows = [row for row in rows_of("TELEMETRY.json", "runs")
+                if row.get("backend") == "tpu" and "error" not in row]
+    if tel_rows:
+        # newest-last history: BOTH companions come from the single last
+        # on-chip run — mixing one run's mfu with another's goodput would
+        # pose as one measurement; CPU-sim tiny reports excluded above
+        row = tel_rows[-1]
+        if row.get("mfu") is not None:
+            result["train_telemetry_mfu"] = row["mfu"]
+        g = row.get("goodput_buckets", {}).get("goodput")
+        if g is not None:
+            result["train_telemetry_goodput"] = g
     for row in rows_of("BENCH_LM.json", "decode", "rows"):
         if (row.get("backend") == "tpu"
                 and row.get("decode_tokens_per_sec")

@@ -14,7 +14,14 @@ imports executed):
 - direct ``jax.lax.all_gather``/``psum_scatter`` calls in ``models/`` —
   model code must route TP collectives through ``dtf_tpu.core.comms``
   (one choke point: the comms-budget fence and the ``--tp_overlap``
-  collective-matmul dispatch both live behind it).
+  collective-matmul dispatch both live behind it),
+- blocking device readbacks (``int(...)``/``float(...)``/``.item()``) in
+  the iteration loop of ``dtf_tpu/loop.py``'s ``Trainer.fit`` — the hot
+  path is SYNC-FREE (PR 3: a per-step readback serializes dispatch
+  against compute and defeats the prefetch double-buffer); designated
+  backpressure points carry a ``# blocking-ok: <why>`` marker. This
+  protects the invariant statically; tests/test_telemetry.py proves it
+  dynamically with the counter-instrumented fit.
 
 Usage: ``python -m dtf_tpu.analysis.srclint PATH [PATH ...]`` — prints one
 finding per line, exits 1 if any.
@@ -150,6 +157,56 @@ def lint_file(path: str) -> list[str]:
                     f"dtf_tpu.core.comms (the comms-budget fence and "
                     f"--tp_overlap dispatch choke point)")
 
+    # ---- blocking readbacks in the trainer hot path (loop.py fit) ----
+    if os.path.basename(path) == "loop.py" and (
+            "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
+        problems += _hotpath_readbacks(tree, path, noqa, src)
+
+    return problems
+
+
+def _hotpath_readbacks(tree, path: str, noqa: set, src: str) -> list:
+    """``int()``/``float()``/``.item()`` inside the iteration loop of
+    ``Trainer.fit`` — each is a blocking device readback serializing host
+    dispatch against device compute (the PR 3 sync-free invariant). The
+    one-time resume sync sits BEFORE the loop and is legal; an intentional
+    backpressure point inside it must carry ``# blocking-ok: <why>``."""
+    allowed = {i for i, line in enumerate(src.splitlines(), 1)
+               if "# blocking-ok" in line}
+
+    def loops_of_fit():
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and fn.name == "fit":
+                    for node in ast.walk(fn):
+                        if isinstance(node, (ast.For, ast.While)):
+                            yield node
+
+    problems = []
+    seen: set[int] = set()
+    for loop in loops_of_fit():
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or node.lineno in seen:
+                continue
+            name = None
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in ("int", "float"):
+                name = f"{node.func.id}(...)"
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item":
+                name = ".item()"
+            if name is None or node.lineno in noqa \
+                    or node.lineno in allowed:
+                continue
+            seen.add(node.lineno)
+            problems.append(
+                f"{path}:{node.lineno}: {name} in Trainer.fit's hot loop "
+                f"— a blocking device readback breaks the sync-free loop "
+                f"(PR 3); move it to a hook or mark a designated "
+                f"backpressure point with '# blocking-ok: <why>'")
     return problems
 
 

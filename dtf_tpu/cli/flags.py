@@ -84,6 +84,30 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000,
                          "to <logdir>/profile")
     flags.DEFINE_integer("profile_start", 10, "step at which the profiler "
                          "trace window opens")
+    flags.DEFINE_boolean("profile_on_demand", True, "accept live-run "
+                         "profile requests: SIGUSR1 or `touch "
+                         "<logdir>/profile.trigger` opens a "
+                         "--profile_steps-wide (default 5) trace window at "
+                         "the next step boundary, no restart needed")
+    flags.DEFINE_boolean("telemetry", False, "run-wide observability "
+                         "(docs/OBSERVABILITY.md): step-phase spans "
+                         "(data_wait/h2d/dispatch/hooks p50/p99), MFU + "
+                         "goodput accounting, a train-step compile fence, "
+                         "and a crash flight recorder dumping the last "
+                         "steps to <logdir>/telemetry/postmortem.json on "
+                         "crash/stall/SIGTERM. One RunReport JSON line "
+                         "prints at exit. Host-side timers only: adds zero "
+                         "blocking device readbacks to the training loop")
+    flags.DEFINE_integer("telemetry_keep_steps", 64, "flight-recorder ring "
+                         "size: step records kept for the postmortem")
+    flags.DEFINE_float("telemetry_min_stall_s", 60.0, "stall watchdog "
+                       "floor: no step completion within max(this, "
+                       "factor x p99 step time) dumps a stall "
+                       "postmortem (0 disables the watchdog thread)")
+    flags.DEFINE_float("telemetry_stall_factor", 10.0, "stall watchdog "
+                       "multiple of the p99 recent step time (set the "
+                       "floor above the longest expected hook pause — eval "
+                       "sweep / checkpoint wait)")
 
 
 def make_lr_schedule(FLAGS):

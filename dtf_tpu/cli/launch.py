@@ -55,7 +55,8 @@ def setup(FLAGS):
 
 
 def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,
-                 *, kind, mode, vocab_size, batch_shardings=None):
+                 *, kind, mode, vocab_size, batch_shardings=None,
+                 telemetry=None):
     """EvalHook for the LM launchers — the one copy of the eval policy.
 
     Held-out source: ``<data_dir>/val.bin`` when present; a synthetic
@@ -92,20 +93,79 @@ def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,
         batches_fn = lambda: (held_out.batch(10_000_000 + i)  # noqa: E731
                               for i in range(4))
     step = tr.make_eval_step(eval_fn, mesh, shardings,
-                             batch_shardings=batch_shardings)
+                             batch_shardings=batch_shardings,
+                             telemetry=telemetry)
     return EvalHook(step, batches_fn, writer,
                     FLAGS.eval_every or FLAGS.train_steps,
                     place_batch=place_batch)
 
 
 def profiler_hooks(FLAGS):
-    """[ProfilerHook] from ``--profile_steps``/``--profile_start``, or []."""
-    if not getattr(FLAGS, "profile_steps", 0):
-        return []
+    """[ProfilerHook] from the profiler flags, or [].
+
+    ``--profile_steps`` schedules the classic fixed window; independently,
+    ``--profile_on_demand`` (default on) arms the live triggers — SIGUSR1
+    or ``touch <logdir>/profile.trigger`` — so a misbehaving run can be
+    profiled without restarting with a pre-chosen step window. One hook
+    serves both modes (dtf_tpu/hooks.py ProfilerHook docstring).
+    """
     import os
+    import signal as _signal
+
+    scheduled = getattr(FLAGS, "profile_steps", 0)
+    on_demand = getattr(FLAGS, "profile_on_demand", False)
+    if not scheduled and not on_demand:
+        return []
 
     from dtf_tpu.hooks import ProfilerHook
 
-    return [ProfilerHook(os.path.join(FLAGS.logdir, "profile"),
-                         start_step=FLAGS.profile_start,
-                         num_steps=FLAGS.profile_steps)]
+    return [ProfilerHook(
+        os.path.join(FLAGS.logdir, "profile"),
+        start_step=FLAGS.profile_start if scheduled else None,
+        num_steps=scheduled or 5,
+        trigger_file=(os.path.join(FLAGS.logdir, "profile.trigger")
+                      if on_demand else None),
+        trigger_signal=(getattr(_signal, "SIGUSR1", None)
+                        if on_demand else None))]
+
+
+def telemetry_from_flags(FLAGS, info):
+    """``--telemetry`` → a configured :class:`dtf_tpu.telemetry.Telemetry`
+    (or None). Built on every host — each host keeps its own flight
+    recorder (postmortems are per-process facts: the host that hangs is
+    the one whose last steps matter) — while :func:`emit_run_report`
+    prints only on the chief."""
+    if not getattr(FLAGS, "telemetry", False):
+        return None
+    import os
+
+    import jax
+
+    from dtf_tpu.telemetry import Telemetry
+
+    min_stall = getattr(FLAGS, "telemetry_min_stall_s", 60.0)
+    out_dir = os.path.join(FLAGS.logdir, "telemetry")
+    if info.num_processes > 1:
+        out_dir = os.path.join(out_dir, f"p{info.process_id}")
+    return Telemetry(
+        out_dir=out_dir,
+        keep_steps=getattr(FLAGS, "telemetry_keep_steps", 64),
+        stall_factor=getattr(FLAGS, "telemetry_stall_factor", 10.0),
+        min_stall_s=min_stall or 60.0,
+        watchdog=bool(min_stall),
+        # global-batch FLOPs vs ALL chips' peak (mfu would otherwise be
+        # overstated by exactly the device count on any multi-chip mesh)
+        n_devices=jax.device_count())
+
+
+def emit_run_report(tel, info, extra=None):
+    """Finish the run's telemetry and print THE one RunReport JSON line
+    (bench.py idiom; chief only). Returns the report dict (all hosts)."""
+    if tel is None:
+        return None
+    import json
+
+    report = tel.finish(extra)
+    if info.is_chief:
+        print(json.dumps(report))
+    return report

@@ -193,6 +193,7 @@ def make_train_step(
     log_grad_norm: bool = True,
     donate: bool = True,
     batch_shardings: PyTree | None = None,
+    telemetry=None,
 ):
     """Build the compiled train step.
 
@@ -386,6 +387,11 @@ def make_train_step(
     # ids but P('data') for [B] labels); default is the P('data') prefix.
     batch_sh = (batch_shardings if batch_shardings is not None
                 else batch_sharding(mesh))
+    if telemetry is not None:
+        # the wrapped body runs once per TRACE (not per call): the compile
+        # fence pins Trainer.trace_counts["train_step"] at 1 in steady
+        # state, the DecodeEngine.trace_counts contract for training.
+        step_fn = telemetry.count_traces("train_step", step_fn)
     return jax.jit(
         step_fn,
         in_shardings=(shardings, batch_sh),
@@ -407,6 +413,7 @@ def make_train_step_from_grads(
     log_grad_norm: bool = True,
     donate: bool = True,
     batch_shardings: PyTree | None = None,
+    telemetry=None,
 ):
     """Train step for losses that produce their own gradients.
 
@@ -437,6 +444,10 @@ def make_train_step_from_grads(
 
     batch_sh = (batch_shardings if batch_shardings is not None
                 else batch_sharding(mesh))
+    if telemetry is not None:
+        # same retrace fence as make_train_step (one program name: the
+        # trainer runs exactly one step program either way)
+        step_fn = telemetry.count_traces("train_step", step_fn)
     return jax.jit(
         step_fn,
         in_shardings=(shardings, batch_sh),
@@ -450,7 +461,7 @@ def make_train_step_from_grads(
 
 
 def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState, *,
-                   batch_shardings: PyTree | None = None):
+                   batch_shardings: PyTree | None = None, telemetry=None):
     """Compiled eval step: ``eval_fn(params, extra, batch) -> metrics dict``.
 
     ``batch_shardings``: override the default data-axis batch placement —
@@ -462,6 +473,8 @@ def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState, *,
     def step_fn(state: TrainState, batch: PyTree):
         return eval_fn(state.params, state.extra, batch)
 
+    if telemetry is not None:
+        step_fn = telemetry.count_traces("eval_step", step_fn)
     return jax.jit(
         step_fn,
         # `is not None`, not truthiness: a falsy-but-valid shardings pytree

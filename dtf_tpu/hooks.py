@@ -27,6 +27,10 @@ class StopTraining(Exception):
 
 
 class Hook:
+    #: goodput bucket the trainer attributes this hook's wall time to when
+    #: telemetry is on (dtf_tpu/telemetry/accounting.GOODPUT_BUCKETS)
+    telemetry_bucket = "hooks"
+
     def begin(self, state: PyTree) -> None: ...
 
     def before_step(self, step: int) -> None: ...
@@ -60,10 +64,24 @@ class LoggingHook(Hook):
 
     Materializing ``metrics`` blocks on the async step, so this is also the
     loop's backpressure point; every_n trades log freshness for overlap.
+
+    Throughput accounting (docs/OBSERVABILITY.md): when the launcher passes
+    ``tokens_per_step`` the log line gains ``tokens_per_sec``, and with
+    ``model_flops_per_step`` (the analytic 6N·tokens rule or an AOT
+    ``cost_analysis()`` count) it gains ``mfu`` vs. ``peak_flops`` — both
+    pure host arithmetic on the steps/sec it already computes. Defaults
+    keep the historical scalars exactly. ``telemetry`` (optional) receives
+    the materialized scalars so the crash flight recorder can report the
+    last known loss without ever blocking on a device value itself.
     """
 
+    telemetry_bucket = "logging"
+
     def __init__(self, writer: MetricWriter, every_n: int = 10,
-                 lr_schedule=None):
+                 lr_schedule=None, *, tokens_per_step=None,
+                 model_flops_per_step=None, peak_flops=None,
+                 throughput_name: str = "tokens_per_sec",
+                 telemetry=None):
         #: optional optax schedule (or plain float) to surface the current
         #: learning rate next to the loss — the schedule position equals
         #: the global step (one optimizer update per step; grad-accum
@@ -71,6 +89,20 @@ class LoggingHook(Hook):
         self.writer = writer
         self.every_n = every_n
         self.lr_schedule = lr_schedule
+        self.tokens_per_step = tokens_per_step
+        self.model_flops_per_step = model_flops_per_step
+        self.throughput_name = throughput_name
+        if peak_flops is None:
+            # model_flops_per_step covers the whole global batch, so the
+            # MFU denominator is the MESH's peak, not one chip's
+            if telemetry is not None:
+                peak_flops = telemetry.peak_flops * telemetry.n_devices
+            else:
+                from dtf_tpu.telemetry.accounting import V5E_PEAK_BF16_FLOPS
+
+                peak_flops = V5E_PEAK_BF16_FLOPS * jax.device_count()
+        self.peak_flops = peak_flops
+        self.telemetry = telemetry
         self._t0 = None
         self._last_logged = None
 
@@ -87,9 +119,16 @@ class LoggingHook(Hook):
         self._t0, self._last_logged = now, step
         scalars = {k: float(v) for k, v in metrics.items()}
         scalars["steps_per_sec"] = sps
+        if self.tokens_per_step:
+            scalars[self.throughput_name] = sps * self.tokens_per_step
+        if self.model_flops_per_step:
+            scalars["mfu"] = (sps * self.model_flops_per_step
+                              / self.peak_flops)
         if self.lr_schedule is not None:
             lr = self.lr_schedule
             scalars["lr"] = float(lr(step) if callable(lr) else lr)
+        if self.telemetry is not None:
+            self.telemetry.note_scalars(step, scalars)
         self.writer.write_scalars(step, scalars)
 
     def end(self, state):
@@ -99,6 +138,8 @@ class LoggingHook(Hook):
 class CheckpointHook(Hook):
     """``CheckpointSaverHook`` equivalent: periodic async sharded saves,
     final save + barrier at end. Orbax dedupes by save_interval_steps."""
+
+    telemetry_bucket = "checkpoint"
 
     def __init__(self, ckpt: Checkpointer, every_n: int = 100):
         self.ckpt = ckpt
@@ -138,6 +179,12 @@ class PreemptionHook(Hook):
     ``signal.signal`` requirement). Restores the previous handlers at
     ``end()`` so short-lived Trainers don't leak handler state.
     """
+
+    # NOT "checkpoint": this hook's steady-state cost is the periodic
+    # flag-sync allgather, a backpressure readback absorbing host
+    # run-ahead (accounting.BACKPRESSURE_BUCKETS) — charging it as
+    # overhead would invert multi-host goodput
+    telemetry_bucket = "preempt_sync"
 
     def __init__(self, ckpt: Checkpointer, signals=(signal.SIGTERM,),
                  check_every: int = 8):
@@ -195,6 +242,8 @@ class EvalHook(Hook):
     ``place_batch`` maps them onto the mesh.
     """
 
+    telemetry_bucket = "eval"
+
     def __init__(self, eval_step, batches, writer: MetricWriter,
                  every_n: int = 100, *, place_batch=None):
         self.eval_step = eval_step
@@ -228,21 +277,96 @@ class EvalHook(Hook):
 
 
 class ProfilerHook(Hook):
-    """``tf.profiler``/Timeline equivalent: capture an XPlane trace window."""
+    """``tf.profiler``/Timeline equivalent: capture an XPlane trace window.
 
-    def __init__(self, logdir: str, start_step: int = 10, num_steps: int = 5):
+    Two trigger modes, composable in one hook:
+
+    - **scheduled** (the original): a window of ``num_steps`` opening at
+      ``start_step``; ``start_step=None`` disables it.
+    - **on-demand** (live-run profiling without a restart): send
+      ``trigger_signal`` (e.g. ``SIGUSR1``) to the process, or ``touch``
+      ``trigger_file`` — checked at step boundaries every ``check_every``
+      steps (an ``os.path.exists`` per check, nothing per step) and
+      CONSUMED (unlinked) when it fires, so one touch = one window. The
+      next window opens at the following step boundary and runs
+      ``num_steps``. Repeatable: touch/kill again after a window closes.
+
+    The signal handler only sets a flag (async-signal-safe, the
+    PreemptionHook discipline) and chains nothing — profiling is
+    process-local. Construct + ``begin()`` in the main thread when using
+    ``trigger_signal`` (CPython's ``signal.signal`` rule); previous
+    handlers are restored at ``end()``.
+    """
+
+    telemetry_bucket = "profile"
+
+    def __init__(self, logdir: str, start_step: int | None = 10,
+                 num_steps: int = 5, *, trigger_file: str | None = None,
+                 trigger_signal: int | None = None, check_every: int = 16):
         self.logdir = logdir
         self.start = start_step
-        self.stop = start_step + num_steps
+        self.num_steps = num_steps
+        self.stop = (start_step + num_steps
+                     if start_step is not None else None)
+        self.trigger_file = trigger_file
+        self.trigger_signal = trigger_signal
+        self.check_every = max(1, check_every)
         self._active = False
+        self._signaled = False
+        self._sched_done = start_step is None
+        self._prev_handler = None
+
+    def begin(self, state):
+        if self.trigger_signal is not None:
+            try:
+                self._prev_handler = signal.signal(
+                    self.trigger_signal, self._on_signal)
+            except ValueError:
+                # not the main thread: file trigger still works, the
+                # signal trigger is simply unavailable here
+                self._prev_handler = None
+
+    def _on_signal(self, signum, frame):
+        self._signaled = True
+
+    def _triggered(self, step) -> bool:
+        if self._signaled:
+            self._signaled = False
+            return True
+        if self.trigger_file and step % self.check_every == 0:
+            import os
+
+            if os.path.exists(self.trigger_file):
+                try:
+                    os.unlink(self.trigger_file)   # consume: one touch,
+                except OSError:                    # one window
+                    pass
+                return True
+        return False
 
     def before_step(self, step):
-        if step == self.start and jax.process_index() == 0:
+        # non-chief processes must not even POLL the triggers: _triggered
+        # consumes the (logdir-shared) trigger file, so a non-chief
+        # polling first would eat the chief's window
+        if jax.process_index() != 0:
+            return
+        # `>=` + once-flag, not `==`: an on-demand window open ACROSS the
+        # scheduled start must not swallow the scheduled window forever
+        # (it covers those steps, so the request is satisfied), and a
+        # resume past start_step must not wait for a step that never comes
+        sched_due = not self._sched_done and step >= self.start
+        if self._active:
+            if sched_due:
+                self._sched_done = True
+            return
+        if sched_due or self._triggered(step):
+            self._sched_done = self._sched_done or sched_due
             jax.profiler.start_trace(self.logdir)
             self._active = True
+            self.stop = step + self.num_steps
 
     def after_step(self, step, state, metrics):
-        if self._active and step >= self.stop:
+        if self._active and self.stop is not None and step >= self.stop:
             jax.profiler.stop_trace()
             self._active = False
 
@@ -250,3 +374,6 @@ class ProfilerHook(Hook):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+        if self._prev_handler is not None:
+            signal.signal(self.trigger_signal, self._prev_handler)
+            self._prev_handler = None

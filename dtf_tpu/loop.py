@@ -9,12 +9,25 @@ compiled step; recovery keeps the same semantics via checkpoint-restart —
 (crash → relaunch → resume), which is exactly the reference's story minus the
 in-process session rebuild (a dead process is relaunched by the cluster
 manager either way).
+
+Observability (``telemetry=``, docs/OBSERVABILITY.md): with a
+:class:`dtf_tpu.telemetry.Telemetry` attached, each iteration is split into
+host-side phase spans — ``data_wait`` (batch production), ``h2d`` (the
+``place_batch`` dispatch), ``dispatch`` (the async train-step call),
+``hooks`` — wrapped in a ``jax.profiler.StepTraceAnnotation`` so XPlane
+traces correlate with the host spans, and fed to the crash flight recorder.
+Every measurement is ``time.perf_counter`` arithmetic: telemetry adds ZERO
+blocking device readbacks to the hot path (the PR 3 sync-free invariant,
+regression-tested with the counter-instrumented idiom), and the srclint
+hot-path fence keeps it that way statically.
 """
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import logging
+import time
 from typing import Any, Callable, Iterable, Sequence
 
 from dtf_tpu.checkpoint import Checkpointer
@@ -33,6 +46,9 @@ class Trainer:
     from :func:`dtf_tpu.core.train.make_train_step`. ``place_batch`` maps a
     host batch onto the mesh (defaults to data-axis sharding; multi-host
     pipelines pass ``comms.host_local_to_global``-based placement).
+    ``telemetry`` (optional) is the run's :class:`~dtf_tpu.telemetry.Telemetry`
+    — pass the SAME object to :func:`~dtf_tpu.core.train.make_train_step` so
+    :attr:`trace_counts` pins the step program's retraces.
     """
 
     def __init__(
@@ -44,6 +60,7 @@ class Trainer:
         checkpointer: Checkpointer | None = None,
         place_batch: Callable | None = None,
         prefetch: int = 2,
+        telemetry=None,
     ):
         self.train_step = train_step
         self.mesh = mesh
@@ -54,6 +71,33 @@ class Trainer:
         # device-side double buffering: batch N+1's H2D transfer dispatches
         # while step N computes (dtf_tpu/data/prefetch.py). 1 = off.
         self.prefetch = prefetch
+        self.telemetry = telemetry
+
+    @property
+    def trace_counts(self) -> dict:
+        """Traces per program — the ``DecodeEngine.trace_counts`` twin.
+        Steady state must stay pinned at 1 per program; needs the same
+        telemetry object threaded through ``make_train_step``."""
+        return self.telemetry.trace_counts if self.telemetry else {}
+
+    def _run_hooks(self, method: str, *args) -> float:
+        """Dispatch one lifecycle method to every hook; with telemetry,
+        time each hook into its goodput bucket (``telemetry_bucket`` class
+        attribute — checkpoint/eval/logging/...). Returns elapsed."""
+        tel = self.telemetry
+        if tel is None:
+            for h in self.hooks:
+                getattr(h, method)(*args)
+            return 0.0
+        t_all = time.perf_counter()
+        for h in self.hooks:
+            t0 = time.perf_counter()
+            try:
+                getattr(h, method)(*args)
+            finally:
+                tel.account(getattr(h, "telemetry_bucket", "hooks"),
+                            time.perf_counter() - t0)
+        return time.perf_counter() - t_all
 
     def fit(self, state: PyTree, batches: Iterable[PyTree],
             *, max_steps: int | None = None) -> PyTree:
@@ -63,13 +107,28 @@ class Trainer:
         checkpointer has a saved step, training resumes from it — the
         relaunch path after a failure needs no special casing.
         """
+        tel = self.telemetry
+        _pc = time.perf_counter
+        if tel is not None:
+            # wall window opens BEFORE restore/begin: the seconds those
+            # account into goodput buckets must fall inside the window
+            tel.open_wall()
         if self.checkpointer is not None:
+            t0 = _pc()
             state, restored = self.checkpointer.restore_if_exists(state)
+            if tel is not None:
+                # the relaunch-overhead goodput bucket: restore cost only
+                # exists because something died (docs/OBSERVABILITY.md)
+                tel.account("restore", _pc() - t0)
             if restored is not None:
                 log.info("resumed from checkpoint at step %d", restored)
 
-        for h in self.hooks:
-            h.begin(state)
+        self._run_hooks("begin", state)
+        # telemetry starts AFTER hook begin: its SIGTERM postmortem hook
+        # must chain OUTSIDE PreemptionHook's handler (ours dumps, then
+        # theirs checkpoints), and signal restore order is LIFO below.
+        if tel is not None:
+            tel.start()
         # ONE device sync, at the resume point: `state.step` is a device
         # array whose int() blocks on the previous step's completion, so
         # reading it every iteration (as this loop once did) serializes
@@ -89,21 +148,77 @@ class Trainer:
         src = batches
         if max_steps is not None:
             src = itertools.islice(batches, max(max_steps - step, 0))
-        staged = prefetch_to_device(src, self.place_batch,
-                                    max(self.prefetch, 1))
+        place = self.place_batch
+        if tel is not None:
+            base_place = place
+
+            def place(b, _base=base_place):
+                t0 = _pc()
+                try:
+                    return _base(b)
+                finally:
+                    dt = _pc() - t0
+                    tel.spans.add("h2d", dt)
+                    tel.account("h2d", dt)
+
+        staged = prefetch_to_device(src, place, max(self.prefetch, 1))
         try:
-            for batch in staged:
+            while True:
                 if max_steps is not None and step >= max_steps:
                     break
-                for h in self.hooks:
-                    h.before_step(step)
-                state, metrics = self.train_step(state, batch)
-                step += 1
-                for h in self.hooks:
-                    h.after_step(step, state, metrics)
+                t_iter = _pc()
+                h2d_before = tel.spans.total("h2d") if tel is not None else 0.0
+                try:
+                    batch = next(staged)
+                except StopIteration:
+                    break
+                if tel is not None:
+                    # batch-production time net of the H2D dispatches that
+                    # ran inside this next() — the two phases stay disjoint.
+                    # The span itself is added by note_step below (once).
+                    dw = max((_pc() - t_iter)
+                             - (tel.spans.total("h2d") - h2d_before), 0.0)
+                    tel.account("data_wait", dw)
+                ann = (tel.step_annotation(step) if tel is not None
+                       else contextlib.nullcontext())
+                with ann:
+                    self._run_hooks("before_step", step)
+                    t_d = _pc()
+                    state, metrics = self.train_step(state, batch)
+                    t_hooks = _pc()
+                    step += 1
+                    try:
+                        self._run_hooks("after_step", step, state, metrics)
+                    finally:
+                        # record even when a hook ends the run (StopTraining
+                        # at the last step) or crashes — the postmortem must
+                        # include the step that was in flight
+                        if tel is not None:
+                            t_end = _pc()
+                            tel.note_step(step, {
+                                "step_s": t_end - t_iter,
+                                "data_wait_s": dw,
+                                "dispatch_s": t_hooks - t_d,
+                                "hooks_s": t_end - t_hooks,
+                            })
         except StopTraining:
             pass
+        except BaseException as e:
+            # the flight recorder's reason-to-exist: the last N step
+            # records hit disk before the stack unwinds (stalls and
+            # SIGTERM have their own dump paths in telemetry/flight.py)
+            if tel is not None:
+                tel.dump_postmortem("crash", {
+                    "step": step, "error": repr(e)[:500]})
+            raise
         finally:
-            for h in self.hooks:
-                h.end(state)
+            # LIFO signal teardown: telemetry restores PreemptionHook's
+            # SIGTERM handler, then the hook's end() restores the original.
+            if tel is not None:
+                tel.stop()
+            self._run_hooks("end", state)
+            if tel is not None:
+                # end hooks (final save + barrier) accounted above still
+                # belong inside the goodput wall window
+                tel.close_wall()
         return state

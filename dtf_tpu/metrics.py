@@ -18,6 +18,18 @@ import jax
 log = logging.getLogger("dtf_tpu")
 
 
+def quantile(xs, q):
+    """Nearest-rank quantile of a small sample (None when empty) — the one
+    shared implementation behind the serve scheduler's TTFT p50/p99 and
+    telemetry's per-phase rollups, so every report quotes the same
+    convention."""
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))
+    return xs[i]
+
+
 class MetricWriter:
     """Scalar writer: stdout logging always, TensorBoard when logdir given."""
 

@@ -12,7 +12,11 @@ Observability rides :class:`dtf_tpu.metrics.MetricWriter` (the training
 stack's writer): queue depth and slot occupancy per logging interval, plus
 per-request TTFT and per-token latency on completion. ``stats()`` returns
 the same aggregates for benches (``scripts/serve_gpt.py`` prints them as
-its one JSON line).
+its one JSON line). With a :class:`dtf_tpu.telemetry.Telemetry` attached
+the engine calls are additionally recorded as ``serve_prefill_chunk`` /
+``serve_decode`` phase spans (host wall time per compiled-program call —
+the training loop's data_wait/dispatch decomposition, serving edition) and
+``stats()`` gains their p50/p99.
 """
 
 from __future__ import annotations
@@ -21,6 +25,8 @@ import collections
 import dataclasses
 import time
 from typing import Optional, Sequence
+
+from dtf_tpu.metrics import quantile as _quantile
 
 
 @dataclasses.dataclass(frozen=True)
@@ -50,14 +56,6 @@ class _Rec:
     finish_t: float = 0.0
 
 
-def _quantile(xs, q):
-    if not xs:
-        return None
-    xs = sorted(xs)
-    i = min(len(xs) - 1, max(0, round(q * (len(xs) - 1))))
-    return xs[i]
-
-
 class Scheduler:
     """FIFO continuous-batching scheduler (see module docstring).
 
@@ -68,10 +66,11 @@ class Scheduler:
 
     def __init__(self, engine, writer=None, *, log_every: int = 0,
                  prefill_chunks_per_tick: int = 4, clock=time.monotonic,
-                 completed_cap: int = 100_000):
+                 completed_cap: int = 100_000, telemetry=None):
         self.engine = engine
         self.writer = writer
         self.log_every = log_every
+        self.telemetry = telemetry
         if prefill_chunks_per_tick < 0:
             # a negative budget would be truthy in tick()'s `or 10**9`
             # fallback yet fail `> 0` — admission silently off, replay()
@@ -146,7 +145,8 @@ class Scheduler:
                 self._admitting = rec
             rec = self._admitting
             r = rec.req
-            out = self.engine.prefill_chunk_into(
+            out = self._timed(
+                "serve_prefill_chunk", self.engine.prefill_chunk_into,
                 rec.slot, r.prompt, rec.chunks_done,
                 temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
                 eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed)
@@ -165,7 +165,7 @@ class Scheduler:
                     self._running[rec.slot] = rec
 
         if self._running:
-            toks, dones = self.engine.decode()
+            toks, dones = self._timed("serve_decode", self.engine.decode)
             now = self.clock()
             for slot, rec in list(self._running.items()):
                 rec.tokens.append(int(toks[slot]))
@@ -186,6 +186,13 @@ class Scheduler:
         raise RuntimeError(f"requests still pending after {max_ticks} ticks")
 
     # ------------------------------------------------------------- internals
+
+    def _timed(self, name, fn, *args, **kwargs):
+        """Engine call under a telemetry phase span (no-op without one)."""
+        if self.telemetry is None:
+            return fn(*args, **kwargs)
+        with self.telemetry.spans.span(name):
+            return fn(*args, **kwargs)
 
     def _budget_spent(self, rec: _Rec) -> bool:
         return (len(rec.tokens) >= rec.req.max_new
@@ -241,4 +248,9 @@ class Scheduler:
             "serve_tok_latency_p50_s": _quantile(self._tok_lats, 0.5),
             "serve_tok_latency_p99_s": _quantile(self._tok_lats, 0.99),
         })
+        if self.telemetry is not None:
+            for name, roll in self.telemetry.spans.rollup().items():
+                if name.startswith("serve_"):
+                    out[f"{name}_p50_s"] = roll["p50_s"]
+                    out[f"{name}_p99_s"] = roll["p99_s"]
         return out

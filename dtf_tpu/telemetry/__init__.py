@@ -1,0 +1,22 @@
+"""Run-wide observability: step-phase spans, MFU/goodput accounting, the
+training compile fence, and the crash flight recorder (docs/OBSERVABILITY.md).
+
+Enable with ``--telemetry`` on any train launcher; programmatic use:
+
+    tel = Telemetry(out_dir=...)
+    step = make_train_step(..., telemetry=tel)
+    Trainer(step, mesh, hooks=..., telemetry=tel).fit(state, batches)
+    print(json.dumps(tel.finish()))      # the one RunReport JSON line
+"""
+
+from dtf_tpu.telemetry.accounting import (GoodputTracker,          # noqa: F401
+                                          RESNET50_TRAIN_FLOPS_PER_IMG,
+                                          V5E_PEAK_BF16_FLOPS,
+                                          analytic_lm_flops_per_step,
+                                          cost_analysis_flops,
+                                          param_count)
+from dtf_tpu.telemetry.fence import CompileFence                   # noqa: F401
+from dtf_tpu.telemetry.flight import (FlightRecorder,              # noqa: F401
+                                      StallWatchdog)
+from dtf_tpu.telemetry.run import Telemetry, merge_artifact        # noqa: F401
+from dtf_tpu.telemetry.spans import SpanRecorder, step_annotation  # noqa: F401

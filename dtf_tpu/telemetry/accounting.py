@@ -1,0 +1,121 @@
+"""MFU / goodput accounting — "what fraction of the hardware are we using,
+and what fraction of the wall clock actually trained?".
+
+MFU follows the two conventions the benches already bank (scripts/
+bench_lm.py, PERF.md §1):
+
+- **analytic**: 6 FLOPs per parameter per token (fwd+bwd weight FLOPs)
+  plus the attention term ``12·L·d·s`` per token — the "Scalable Training
+  of Language Models using JAX pjit and TPUv4" (arxiv 2204.06514)
+  accounting, comparable across papers;
+- **XLA cost analysis**: the AOT ``compiled.cost_analysis()`` flops of the
+  actual program (the bench_cost_table.py idiom) — a LOWER bound (scan
+  bodies counted once, Pallas custom calls report zero).
+
+Goodput = productive step wall time / total run wall time, with the
+non-productive remainder attributed to named buckets (compile, checkpoint,
+eval, logging, restore, data_wait, h2d, other) — the run-level accounting
+the TPU-pod scaling literature reports runs by. Bucket seconds come from
+host timers only (the trainer's per-hook timing plus jax.monitoring's
+compile-duration events); nothing here reads a device value.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional
+
+#: TPU v5e peak bf16 matmul throughput per chip (the bench.py constant).
+V5E_PEAK_BF16_FLOPS = 197e12
+
+#: ResNet-50 v1.5 @224 fwd ≈ 4.09e9 MAC-derived FLOPs/image, training ≈ 3×
+#: fwd (the bench.py constant — keep the two in sync; bench.py cannot
+#: import this module because its parent process never imports jax deps).
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+
+#: goodput buckets the trainer/hook instrumentation feeds; anything else
+#: lands in "other" so the report always sums to the measured overhead.
+GOODPUT_BUCKETS = ("compile", "checkpoint", "eval", "logging", "restore",
+                   "data_wait", "h2d", "hooks", "profile", "preempt_sync",
+                   "other")
+
+#: buckets that are BACKPRESSURE, not lost time: in the sync-free loop the
+#: host blocks inside LoggingHook's metrics readback (and generic hooks)
+#: precisely while the DEVICE works through the dispatched step queue —
+#: charging that wait as overhead would invert goodput on healthy runs
+#: (report ~0.1 while the device is ~99% busy). h2d is the async transfer
+#: dispatch overlapping compute. preempt_sync is PreemptionHook's periodic
+#: multi-host flag allgather — a device readback that absorbs the host's
+#: accumulated run-ahead exactly like LoggingHook's metrics readback (the
+#: rare preemption-save it also covers is once-per-dying-run noise). These
+#: are reported per-bucket but excluded from the productive-time
+#: subtraction.
+BACKPRESSURE_BUCKETS = ("logging", "hooks", "h2d", "preempt_sync")
+
+
+def param_count(params) -> int:
+    """Total parameter count from array METADATA only (``x.size`` never
+    materializes a value, so this is safe on live training state)."""
+    import jax
+
+    return int(sum(x.size for x in jax.tree.leaves(params)))
+
+
+def analytic_lm_flops_per_step(*, n_params: int, layers: int, width: int,
+                               seq_len: int, tokens_per_step: int) -> float:
+    """Full-step (fwd+bwd) FLOPs for a dense transformer LM step —
+    ``(6·N + 12·L·d·s) · tokens`` (the bench_lm.py mfu_analytic model)."""
+    return float(6 * n_params + 12 * layers * width * seq_len) \
+        * tokens_per_step
+
+
+def cost_analysis_flops(fn, *args) -> Optional[float]:
+    """Best-effort AOT flops of ``fn(*args)`` (bench_cost_table idiom).
+
+    Returns None when the backend/program offers no cost analysis. NOTE:
+    lowering here is a fresh trace of ``fn`` — callers that pin trace
+    counts (the compile fence) must account for it or prefer the analytic
+    path.
+    """
+    try:
+        cost = fn.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        return flops or None
+    except Exception:
+        return None
+
+
+class GoodputTracker:
+    """Accumulates overhead seconds into named buckets.
+
+    ``account(bucket, seconds)`` from anywhere on the host (trainer hook
+    timing, checkpoint restore, compile-duration events). Unknown bucket
+    names fold into ``other`` — the report must always reconcile.
+    """
+
+    def __init__(self):
+        self.buckets: dict[str, float] = {}
+
+    def account(self, bucket: str, seconds: float) -> None:
+        if bucket not in GOODPUT_BUCKETS:
+            bucket = "other"
+        self.buckets[bucket] = self.buckets.get(bucket, 0.0) + seconds
+
+    def report(self, total_s: float) -> Mapping[str, float]:
+        """``{goodput, productive_s, <bucket>_s...}`` for ``total_s`` of
+        wall clock. Productive = total − Σ overheads, clamped at 0, where
+        overhead EXCLUDES the :data:`BACKPRESSURE_BUCKETS` (the host's
+        wait on device compute — see their note). Remaining bucket times
+        can still overlap the async device timeline (a compile inside the
+        first dispatch), so the subtraction is an upper bound on lost
+        time, i.e. goodput is conservative on short runs."""
+        overhead = sum(s for b, s in self.buckets.items()
+                       if b not in BACKPRESSURE_BUCKETS)
+        productive = max(total_s - overhead, 0.0)
+        out = {"goodput": round(productive / total_s, 4) if total_s else 0.0,
+               "productive_s": round(productive, 3),
+               "total_s": round(total_s, 3)}
+        for name, s in sorted(self.buckets.items()):
+            out[f"{name}_s"] = round(s, 3)
+        return out

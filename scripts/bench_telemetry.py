@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Telemetry-enabled LM training run → the committed TELEMETRY.json artifact.
+
+Runs a short GPT training loop (synthetic data) with the full telemetry
+stack on — step-phase spans, MFU/goodput accounting, the compile fence,
+the flight recorder — and merges the resulting RunReport into
+TELEMETRY.json with round timestamps (the BENCH_LM.json artifact pattern:
+bounded history, sections survive re-runs). Queued in
+scripts/tpu_pipeline.sh so every tunnel window banks an on-chip goodput/
+MFU/phase-breakdown row next to the throughput benches.
+
+Same resilience contract as bench.py / bench_cost_table.py: this parent
+NEVER imports jax, the child runs under the watchdog behind a probe-first
+budget, and the artifact is always written (a report row or a structured
+error). CPU-sim runs work any round (tiny config; logic check) — pass
+DTF_TEL_TINY=1 or just run without a chip and let the probe route it.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+ARTIFACT = os.path.join(ROOT, "TELEMETRY.json")
+SENTINEL = "TELEMETRY_REPORT "
+CHILD_TIMEOUT_S = 900
+TOTAL_BUDGET_S = float(os.environ.get("DTF_TEL_BUDGET_S", "1200"))
+
+
+def child():
+    import jax
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.mesh import make_mesh
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.hooks import LoggingHook, StopAtStepHook
+    from dtf_tpu.loop import Trainer
+    from dtf_tpu.metrics import MetricWriter
+    from dtf_tpu.models import gpt
+    from dtf_tpu.telemetry import (Telemetry, analytic_lm_flops_per_step,
+                                   param_count)
+
+    tiny = os.environ.get("DTF_TEL_TINY") == "1"
+    # batch must divide over the data axis (8-way on the CPU sim)
+    b = int(os.environ.get("DTF_TEL_BATCH", "8"))
+    s = int(os.environ.get("DTF_TEL_SEQ", "64" if tiny else "512"))
+    n_steps = int(os.environ.get("DTF_TEL_STEPS", "12"))
+    cfg = gpt.GPTConfig.tiny() if tiny else gpt.GPTConfig.gpt2_small()
+
+    mesh = make_mesh()
+    # global-batch FLOPs vs the whole mesh's peak (n_devices divisor)
+    tel = Telemetry(min_stall_s=300.0, n_devices=mesh.devices.size)
+    model, init_fn = gpt.make_init(cfg, mesh, seq_len=s)
+    tx = optax.adamw(1e-4)
+    state, shardings = tr.create_train_state(
+        init_fn, tx, jax.random.PRNGKey(0), mesh, param_rules=gpt.tp_rules)
+    step = tr.make_train_step(gpt.make_loss(model), tx, mesh, shardings,
+                              telemetry=tel)
+    tokens = b * s
+    tel.set_throughput_model(
+        tokens_per_step=tokens,
+        model_flops_per_step=analytic_lm_flops_per_step(
+            n_params=param_count(state.params), layers=cfg.layers,
+            width=cfg.d_model, seq_len=s, tokens_per_step=tokens))
+
+    data = SyntheticData("gpt", b, seed=0, seq_len=s,
+                         vocab_size=cfg.vocab_size)
+    trainer = Trainer(
+        step, mesh,
+        hooks=[LoggingHook(MetricWriter(None, also_log=False), 4,
+                           tokens_per_step=tokens, telemetry=tel),
+               StopAtStepHook(n_steps)],
+        telemetry=tel)
+    trainer.fit(state, iter(data))
+    report = tel.finish({
+        "backend": jax.default_backend(),
+        "n_devices": mesh.devices.size,
+        "model": "gpt", "tiny": tiny, "batch": b, "seq": s})
+    print(SENTINEL + json.dumps(report))
+
+
+def _merge(path, entry, meta, keep_runs=20):
+    """telemetry.run.merge_artifact, replicated: importing anything under
+    dtf_tpu pulls _jax_compat → jax, which this parent must never do."""
+    data = {"runs": []}
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        if isinstance(prev, dict) and isinstance(prev.get("runs"), list):
+            data = prev
+    except (OSError, ValueError):
+        pass
+    data["runs"] = (data["runs"] + [{**entry, **meta}])[-keep_runs:]
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+
+
+def main():
+    from _dtf_watchdog import Budget, child_argv, probe_backend, \
+        run_watchdogged
+
+    budget = Budget(TOTAL_BUDGET_S)
+    meta = {"ts": round(time.time(), 1),
+            "round": os.environ.get("DTF_ROUND", "")}
+    backend, errs = probe_backend(
+        timeout_s=min(90, max(10.0, budget.remaining(10))),
+        retries=2, backoff_s=10, env=dict(os.environ))
+    if backend is None:
+        _merge(ARTIFACT, {
+            "telemetry": "run_report_error",
+            "error": ("backend unavailable (probe failed): "
+                      + "; ".join(errs))[:2000]}, meta)
+        print(json.dumps({"error": "probe failed"}))
+        return 0
+
+    def parse(line):
+        if line.startswith(SENTINEL):
+            try:
+                return json.loads(line[len(SENTINEL):])
+            except ValueError:
+                return None
+        return None
+
+    report, errors = run_watchdogged(
+        child_argv(os.path.abspath(__file__)), parse,
+        timeout_s=min(CHILD_TIMEOUT_S, max(60.0, budget.remaining(30))),
+        retries=1, backoff_s=0, env=dict(os.environ))
+    if report is None:
+        report = {"telemetry": "run_report_error",
+                  "error": (f"probe OK (backend={backend}) but telemetry "
+                            "run failed: " + "; ".join(errors))[:2000]}
+    _merge(ARTIFACT, report, meta)
+    print(json.dumps({"ok": "error" not in report,
+                      "backend": backend,
+                      "mfu": report.get("mfu"),
+                      "goodput": report.get("goodput_buckets",
+                                            {}).get("goodput")}))
+    return 0
+
+
+if __name__ == "__main__":
+    if "--child" in sys.argv:
+        child()
+    else:
+        sys.exit(main())

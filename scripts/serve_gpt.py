@@ -75,6 +75,10 @@ flags.DEFINE_integer("prompt_max", 64, "Poisson-mode max prompt length")
 flags.DEFINE_integer("new_min", 8, "Poisson-mode min new tokens")
 flags.DEFINE_integer("new_max", 64, "Poisson-mode max new tokens")
 flags.DEFINE_boolean("emit_tokens", False, "print rid:tok,... per request")
+flags.DEFINE_boolean("telemetry", False, "per-engine-call phase spans "
+                     "(serve_prefill_chunk / serve_decode p50/p99 in the "
+                     "JSON line) and a compile-event fence over the serve "
+                     "loop (docs/OBSERVABILITY.md)")
 FLAGS = flags.FLAGS
 
 
@@ -144,10 +148,20 @@ def main(argv):
                               prefill_chunk=FLAGS.prefill_chunk, mesh=mesh)
     except ValueError as e:     # n_slots/max_len/prefill_chunk flag errors
         raise app.UsageError(str(e))
+    tel = None
+    if FLAGS.telemetry:
+        from dtf_tpu.telemetry import Telemetry
+
+        # serving has its own stall story (the scheduler loop is
+        # host-driven); spans + the compile fence are what telemetry
+        # adds here, so no watchdog thread
+        tel = Telemetry(watchdog=False)
+        tel.start()
     writer = MetricWriter(None, also_log=False)
     sched = Scheduler(
         engine, writer, log_every=0,
-        prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
+        prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
+        telemetry=tel)
 
     eos = FLAGS.eos_id if FLAGS.eos_id >= 0 else None
     t0 = time.perf_counter()
@@ -205,6 +219,13 @@ def main(argv):
            "cache_mib": round(engine.cache_bytes() / 2 ** 20, 2)}
     out.update({k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in sched.stats().items()})
+    if tel is not None:
+        tel.stop()
+        out["trace_counts"] = dict(engine.trace_counts)
+        out["compile_events"] = tel.fence.compile_events
+        # without this flag, compile_events==0 would be ambiguous between
+        # "steady state" and "jax.monitoring unobservable on this jax"
+        out["monitoring_available"] = tel.fence.monitoring_available
     print(json.dumps(out))
 
 

@@ -51,7 +51,9 @@ def main(argv):
     from jax.sharding import PartitionSpec as P
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import (lm_eval_hook, profiler_hooks, setup)
+    from dtf_tpu.cli.launch import (emit_run_report, lm_eval_hook,
+                                    profiler_hooks, setup,
+                                    telemetry_from_flags)
     from dtf_tpu.core import train as tr
     from dtf_tpu.core.comms import batch_shardings_for
     from dtf_tpu.data.synthetic import SyntheticData
@@ -63,6 +65,7 @@ def main(argv):
 
     mesh, info = setup(FLAGS)
     sp = mesh.shape.get("seq", 1) > 1
+    tel = telemetry_from_flags(FLAGS, info)
 
     if FLAGS.tp_overlap and mesh.shape.get("model", 1) <= 1:
         absl_logging.warning(
@@ -133,9 +136,24 @@ def main(argv):
         bert.make_loss(model, loss_chunk=FLAGS.loss_chunk_vocab,
                        mlm_gather=FLAGS.mlm_gather), tx, mesh,
         shardings, grad_accum=FLAGS.grad_accum, grad_shard=grad_shard,
-        **kwargs)
+        telemetry=tel, **kwargs)
 
     from dtf_tpu.core.comms import shard_batch
+
+    tokens_per_step = model_flops = None
+    if tel is not None:
+        # analytic MFU model (bench_lm mfu_analytic convention); an AOT
+        # cost_analysis() would re-trace the step and unpin the fence
+        from dtf_tpu.telemetry import (analytic_lm_flops_per_step,
+                                       param_count)
+
+        tokens_per_step = FLAGS.batch_size * FLAGS.seq_len
+        model_flops = analytic_lm_flops_per_step(
+            n_params=param_count(state.params), layers=cfg.layers,
+            width=cfg.hidden, seq_len=FLAGS.seq_len,
+            tokens_per_step=tokens_per_step)
+        tel.set_throughput_model(tokens_per_step=tokens_per_step,
+                                 model_flops_per_step=model_flops)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
@@ -146,18 +164,26 @@ def main(argv):
         bert.make_eval(model, loss_chunk=FLAGS.loss_chunk_vocab,
                        mlm_gather=FLAGS.mlm_gather), writer,
         place_batch, kind="bert", mode="mlm", vocab_size=cfg.vocab_size,
-        batch_shardings=kwargs.get("batch_shardings"))
+        batch_shardings=kwargs.get("batch_shardings"), telemetry=tel)
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
+                           tokens_per_step=tokens_per_step,
+                           model_flops_per_step=model_flops,
+                           telemetry=tel),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt,
-        place_batch=place_batch)
+        place_batch=place_batch,
+        telemetry=tel)
     state = trainer.fit(state, iter(data))
+    emit_run_report(tel, info, extra={
+        "launcher": "train_bert", "size": FLAGS.size,
+        "batch_size": FLAGS.batch_size, "seq_len": FLAGS.seq_len,
+        "mesh": dict(mesh.shape)})
     writer.close()
     ckpt.close()
     print(f"done: step={int(state.step)}")

@@ -91,7 +91,9 @@ def main(argv):
     from jax.sharding import PartitionSpec as P
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import (lm_eval_hook, profiler_hooks, setup)
+    from dtf_tpu.cli.launch import (emit_run_report, lm_eval_hook,
+                                    profiler_hooks, setup,
+                                    telemetry_from_flags)
     from dtf_tpu.core import train as tr
     from dtf_tpu.core.comms import batch_shardings_for, shard_batch
     from dtf_tpu.data.synthetic import SyntheticData
@@ -103,6 +105,7 @@ def main(argv):
 
     mesh, info = setup(FLAGS)
     sp = mesh.shape.get("seq", 1) > 1
+    tel = telemetry_from_flags(FLAGS, info)
 
     try:
         base = gpt.GPTConfig.by_name(FLAGS.size)
@@ -273,7 +276,7 @@ def main(argv):
                 "--grad_shard has no effect with --pipe_schedule=1f1b "
                 "(microbatching lives inside the fused schedule)")
         step = tr.make_train_step_from_grads(grads_fn, tx, mesh, shardings,
-                                             **kwargs)
+                                             telemetry=tel, **kwargs)
     else:
         # --grad_shard viability: the sharded accumulator needs a
         # pure-GSPMD loss — the shard_map kernels (ring/zigzag/halo/flash
@@ -302,7 +305,24 @@ def main(argv):
                                                blockers=blockers)
         step = tr.make_train_step(loss_fn, tx, mesh, shardings,
                                   grad_accum=FLAGS.grad_accum,
-                                  grad_shard=grad_shard, **kwargs)
+                                  grad_shard=grad_shard, telemetry=tel,
+                                  **kwargs)
+
+    tokens_per_step = model_flops = None
+    if tel is not None:
+        # analytic MFU model (the bench_lm mfu_analytic convention): no
+        # extra trace — an AOT cost_analysis() here would re-lower the
+        # step and unpin the compile fence (telemetry/accounting.py)
+        from dtf_tpu.telemetry import (analytic_lm_flops_per_step,
+                                       param_count)
+
+        tokens_per_step = FLAGS.batch_size * FLAGS.seq_len
+        model_flops = analytic_lm_flops_per_step(
+            n_params=param_count(state.params), layers=cfg.layers,
+            width=cfg.d_model, seq_len=FLAGS.seq_len,
+            tokens_per_step=tokens_per_step)
+        tel.set_throughput_model(tokens_per_step=tokens_per_step,
+                                 model_flops_per_step=model_flops)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
@@ -328,18 +348,26 @@ def main(argv):
     eval_hook = lm_eval_hook(
         FLAGS, info, mesh, shardings, eval_fn, writer,
         place_batch, kind="gpt", mode="clm", vocab_size=cfg.vocab_size,
-        batch_shardings=kwargs.get("batch_shardings"))
+        batch_shardings=kwargs.get("batch_shardings"), telemetry=tel)
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
+                           tokens_per_step=tokens_per_step,
+                           model_flops_per_step=model_flops,
+                           telemetry=tel),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
         checkpointer=ckpt,
-        place_batch=place_batch)
+        place_batch=place_batch,
+        telemetry=tel)
     state = trainer.fit(state, iter(data))
+    emit_run_report(tel, info, extra={
+        "launcher": "train_gpt", "size": FLAGS.size,
+        "batch_size": FLAGS.batch_size, "seq_len": FLAGS.seq_len,
+        "mesh": dict(mesh.shape)})
     writer.close()
     ckpt.close()
     print(f"done: step={int(state.step)}")

@@ -36,7 +36,8 @@ def main(argv):
     import optax
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import profiler_hooks, setup
+    from dtf_tpu.cli.launch import (emit_run_report, profiler_hooks, setup,
+                                    telemetry_from_flags)
     from dtf_tpu.core import train as tr
     from dtf_tpu.data.synthetic import SyntheticData
     from dtf_tpu.core.comms import shard_batch
@@ -47,6 +48,7 @@ def main(argv):
     from dtf_tpu.models import resnet
 
     mesh, info = setup(FLAGS)
+    tel = telemetry_from_flags(FLAGS, info)
 
     if FLAGS.config == "cifar":
         model, shape, kind = resnet.resnet20(), (32, 32, 3), "cifar"
@@ -68,7 +70,20 @@ def main(argv):
         mesh)
     step = tr.make_train_step(
         resnet.make_loss(model, weight_decay=loss_l2), tx, mesh,
-        shardings, grad_accum=FLAGS.grad_accum)
+        shardings, grad_accum=FLAGS.grad_accum, telemetry=tel)
+
+    examples_per_step = model_flops = None
+    if tel is not None:
+        # throughput model: examples/step always; FLOPs only for the
+        # ResNet-50 config, where the bench.py per-image constant applies
+        from dtf_tpu.telemetry import RESNET50_TRAIN_FLOPS_PER_IMG
+
+        examples_per_step = FLAGS.batch_size
+        if kind == "imagenet":
+            model_flops = RESNET50_TRAIN_FLOPS_PER_IMG * FLAGS.batch_size
+        tel.set_throughput_model(tokens_per_step=examples_per_step,
+                                 model_flops_per_step=model_flops,
+                                 throughput_name="examples_per_sec")
 
     from dtf_tpu.data import formats
 
@@ -120,14 +135,22 @@ def main(argv):
             place_batch=lambda b: shard_batch(b, mesh))
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
+                           tokens_per_step=examples_per_step,
+                           model_flops_per_step=model_flops,
+                           throughput_name="examples_per_sec",
+                           telemetry=tel),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
-        checkpointer=ckpt)
+        checkpointer=ckpt,
+        telemetry=tel)
     state = trainer.fit(state, iter(data))
+    emit_run_report(tel, info, extra={
+        "launcher": "train_resnet", "config": FLAGS.config,
+        "batch_size": FLAGS.batch_size, "mesh": dict(mesh.shape)})
     writer.close()
     ckpt.close()
     print(f"done: step={int(state.step)}")

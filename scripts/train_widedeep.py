@@ -30,7 +30,8 @@ def main(argv):
     import optax
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import profiler_hooks, setup
+    from dtf_tpu.cli.launch import (emit_run_report, profiler_hooks, setup,
+                                    telemetry_from_flags)
     from dtf_tpu.core import train as tr
     from dtf_tpu.core.comms import shard_batch
     from dtf_tpu.data.synthetic import SyntheticData
@@ -41,6 +42,7 @@ def main(argv):
     from dtf_tpu.models import widedeep
 
     mesh, info = setup(FLAGS)
+    tel = telemetry_from_flags(FLAGS, info)
 
     model = widedeep.WideDeep(hash_buckets=FLAGS.hash_buckets,
                               embed_dim=FLAGS.embed_dim)
@@ -50,7 +52,12 @@ def main(argv):
         widedeep.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=widedeep.rules)
     step = tr.make_train_step(widedeep.make_loss(model), tx, mesh, shardings,
-                              grad_accum=FLAGS.grad_accum)
+                              grad_accum=FLAGS.grad_accum, telemetry=tel)
+    if tel is not None:
+        # CTR rows have no FLOPs convention worth quoting; examples/sec
+        # and goodput are the meaningful numbers here
+        tel.set_throughput_model(tokens_per_step=FLAGS.batch_size,
+                                 throughput_name="examples_per_sec")
 
     from dtf_tpu.data import formats
 
@@ -91,14 +98,21 @@ def main(argv):
                              "split; skipping periodic eval")
     trainer = Trainer(
         step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
+        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
+                           tokens_per_step=(FLAGS.batch_size if tel else None),
+                           throughput_name="examples_per_sec",
+                           telemetry=tel),
                CheckpointHook(ckpt, FLAGS.checkpoint_every),
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS)],
-        checkpointer=ckpt)
+        checkpointer=ckpt,
+        telemetry=tel)
     state = trainer.fit(state, iter(data))
+    emit_run_report(tel, info, extra={
+        "launcher": "train_widedeep", "batch_size": FLAGS.batch_size,
+        "mesh": dict(mesh.shape)})
     writer.close()
     ckpt.close()
     print(f"done: step={int(state.step)}")
