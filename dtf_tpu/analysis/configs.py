@@ -267,6 +267,22 @@ def _gpt_serve_step(mesh):
     return StepView(step, abs_params, abs_state)
 
 
+def _gpt_serve_int8_step(mesh):
+    """``gpt_serve`` with ``kv_cache_dtype="int8"`` — the quantized-KV
+    decode graph (int8 K/V + f32 per-position scales in the cache,
+    dequant-on-read inside the step). Fenced separately so the dequant
+    multiplies can never grow a collective the bf16 fence wouldn't see
+    (the cache leaves carry the SAME shardings; only dtypes and the scale
+    leaves differ — docs/ANALYSIS.md)."""
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve.engine import decode_step_view
+
+    step, abs_params, abs_state = decode_step_view(
+        gpt.GPTConfig.tiny(kv_cache_dtype="int8"), n_slots=8, max_len=64,
+        mesh=mesh)
+    return StepView(step, abs_params, abs_state)
+
+
 def _gpt_pipe_spec(mesh):
     from dtf_tpu.models import gpt, gpt_pipe
 
@@ -356,6 +372,11 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    _gpt_spec(), _gpt_serve_step,
                    # decode-mode config: the step is the serving engine's
                    # decode_all, not a train step (dtf_tpu/serve).
+                   allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_serve_int8", MeshConfig(data=4, model=2),
+                   _gpt_spec(), _gpt_serve_int8_step,
+                   # the quantized-KV serving decode graph (same mesh,
+                   # same spec view — params don't quantize).
                    allow_dead=(r"w_(in|out)$",)),
     AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),
                    _gpt_pipe_spec, _gpt_pipe_step("gpipe"),

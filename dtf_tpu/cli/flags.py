@@ -259,7 +259,8 @@ DECODE_MANIFEST_FIELDS = ("size", "kv_heads", "attn_window",
                           "attn_global_every")
 
 
-def resolve_decode_config(FLAGS, manifest):
+def resolve_decode_config(FLAGS, manifest, *, max_len=None,
+                          kv_page_size=None):
     """Merge the checkpoint's ``model_config.json`` manifest into the
     serving flags (``generate_gpt.py`` / ``serve_gpt.py``).
 
@@ -268,31 +269,73 @@ def resolve_decode_config(FLAGS, manifest):
     silently), a matching or unset flag just follows it. No manifest (old
     checkpoint): flags pass through untouched, exactly the old contract.
     ``kv_cache_dtype`` is a serving-side choice, not an architecture fact,
-    so the flag always wins and the manifest only supplies a default.
+    so the flag always wins and the manifest only supplies a default —
+    but the CHOICE is validated here against the manifest's architecture
+    (head dim) and the serving shape (``max_len``/``kv_page_size``), so an
+    illegal combination fails at flag resolution with a usable message
+    instead of deep inside the engine's AOT build.
     Raises ValueError — launchers convert to their UsageError.
     """
     out = {f: getattr(FLAGS, f) for f in DECODE_MANIFEST_FIELDS}
     out["kv_cache_dtype"] = getattr(FLAGS, "kv_cache_dtype", "")
-    if manifest is None:
-        return out
-    if int(manifest.get("moe_every", 0) or 0):
-        raise ValueError(
-            f"checkpoint was trained with moe_every="
-            f"{manifest['moe_every']}; the decode stack has no MoE path — "
-            "serving a Switch-MoE checkpoint would silently drop the "
-            "expert weights")
-    for f in DECODE_MANIFEST_FIELDS:
-        if f not in manifest:
-            continue
-        if FLAGS[f].present and getattr(FLAGS, f) != manifest[f]:
+    if manifest is not None:
+        if int(manifest.get("moe_every", 0) or 0):
             raise ValueError(
-                f"--{f}={getattr(FLAGS, f)!r} contradicts the checkpoint "
-                f"manifest ({manifest[f]!r}); drop the flag — the manifest "
-                "written by the training launcher is authoritative")
-        out[f] = manifest[f]
-    if not FLAGS["kv_cache_dtype"].present and "kv_cache_dtype" in manifest:
-        out["kv_cache_dtype"] = manifest["kv_cache_dtype"]
+                f"checkpoint was trained with moe_every="
+                f"{manifest['moe_every']}; the decode stack has no MoE "
+                "path — serving a Switch-MoE checkpoint would silently "
+                "drop the expert weights")
+        for f in DECODE_MANIFEST_FIELDS:
+            if f not in manifest:
+                continue
+            if FLAGS[f].present and getattr(FLAGS, f) != manifest[f]:
+                raise ValueError(
+                    f"--{f}={getattr(FLAGS, f)!r} contradicts the "
+                    f"checkpoint manifest ({manifest[f]!r}); drop the "
+                    "flag — the manifest written by the training launcher "
+                    "is authoritative")
+            out[f] = manifest[f]
+        if (not FLAGS["kv_cache_dtype"].present
+                and "kv_cache_dtype" in manifest):
+            out["kv_cache_dtype"] = manifest["kv_cache_dtype"]
+    _validate_kv_cache_dtype(out["kv_cache_dtype"], manifest,
+                             max_len=max_len, kv_page_size=kv_page_size)
     return out
+
+
+def _validate_kv_cache_dtype(dtype: str, manifest, *, max_len=None,
+                             kv_page_size=None) -> None:
+    """The serving-side KV choices, checked where the error is cheap.
+
+    Everything here WOULD otherwise surface as an opaque trace/compile
+    error inside ``DecodeEngine``'s AOT build (or, worse, garbled decode):
+    an unknown dtype string, an int8 cache on an architecture whose head
+    dim breaks the rope-pair/scale layout, or a page size that does not
+    divide the per-slot cache length (a page window crossing the cache end
+    cannot be copied fixed-shape).
+    """
+    if dtype not in ("", "int8"):
+        raise ValueError(
+            f"kv_cache_dtype={dtype!r} must be '' (store at model dtype) "
+            "or 'int8'")
+    if kv_page_size is not None and kv_page_size:
+        if kv_page_size < 1:
+            raise ValueError(f"kv_page_size={kv_page_size} must be >= 1")
+        if max_len is not None and max_len % kv_page_size:
+            raise ValueError(
+                f"kv_page_size={kv_page_size} does not divide the per-slot "
+                f"cache length max_len={max_len}; pick a page size that "
+                "tiles the cache (pages are fixed-shape copies)")
+    if dtype == "int8" and manifest is not None:
+        d_model = int(manifest.get("d_model", 0) or 0)
+        heads = int(manifest.get("heads", 0) or 0)
+        if d_model and heads:
+            d_head = d_model // heads
+            if d_head % 2:
+                raise ValueError(
+                    f"kv_cache_dtype=int8 needs an even head dim (rope "
+                    f"pairs lanes); manifest says d_model={d_model} / "
+                    f"heads={heads} -> d_head={d_head}")
 
 
 def resolve_grad_shard(FLAGS, mesh, *, blockers=()):

@@ -1007,10 +1007,105 @@ def _prefill(model: GPT, params, cache0, prompt, prefill_chunk: int):
 #: cache-collection leaves whose leading dim is the batch (beam search
 #: clones and reorders exactly these); every other cache key must appear in
 #: _NON_BATCH_CACHE_KEYS, so an unrecognized leaf fails loudly instead of
-#: silently riding the beams unreordered.
+#: silently riding the beams unreordered. The SAME set is the paged-leaf
+#: registry: every batch-led leaf is [rows, H, L, D]-shaped, so the prefix
+#: page cache (dtf_tpu/serve/pages.py) reads/writes fixed-size windows of
+#: the L axis through :func:`cache_load_pages` / :func:`cache_save_pages` —
+#: a new cache variable added to _cache_vars must be classified here or
+#: every consumer (beams, serve slot slicing, pages) fails loudly at once.
 _BATCH_LED_CACHE_KEYS = frozenset(
     {"cached_key", "cached_value", "key_scale", "value_scale"})
 _NON_BATCH_CACHE_KEYS = frozenset({"cache_index"})
+
+
+def _path_key(k) -> str:
+    return getattr(k, "key", str(k))
+
+
+def _cache_leaf_name(path) -> str:
+    return _path_key(path[-1])
+
+
+def _set_by_path(tree: dict, path, leaf) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(_path_key(k), {})
+    node[_path_key(path[-1])] = leaf
+
+
+def _get_by_path(tree, path):
+    node = tree
+    for k in path:
+        node = node[_path_key(k)]
+    return node
+
+
+def _paged_leaf_check(name: str) -> bool:
+    """True for paged leaves, False for index leaves; loud otherwise —
+    the completeness contract of ``_BATCH_LED_CACHE_KEYS``."""
+    if name in _NON_BATCH_CACHE_KEYS:
+        return False
+    if name not in _BATCH_LED_CACHE_KEYS:
+        raise ValueError(
+            f"unknown cache leaf {name!r}: add it to "
+            "_BATCH_LED_CACHE_KEYS or _NON_BATCH_CACHE_KEYS so the "
+            "page cache knows whether to page it")
+    return True
+
+
+def cache_load_pages(cache, pool, slot, page_ids, n_valid):
+    """The paged READ view: gather pool pages ``page_ids[:n_valid]`` into
+    the leading positions of slot ``slot`` of every batch-led cache leaf,
+    in ONE fixed-shape op (the serving prefix cache admits a whole pinned
+    chain per compiled call — per-page dispatches would cost as much host
+    overhead as the transformer chunks they replace).
+
+    ``cache`` leaves are ``[S, H, L, D]``, ``pool`` leaves ``[P, H, p, D]``
+    at the same tree paths with ``L = len(page_ids) * p`` (pages tile the
+    cache — the engine validates ``max_len % page_size == 0``); entries of
+    ``page_ids`` at or past ``n_valid`` are ignored (positions keep their
+    current contents). Copies are bitwise: int8 caches bring their scale
+    leaves through the same paths."""
+    def per_leaf(path, leaf):
+        if not _paged_leaf_check(_cache_leaf_name(path)):
+            return leaf
+        pleaf = _get_by_path(pool, path)
+        p = pleaf.shape[2]
+        m = leaf.shape[2] // p
+        # OOB-safe: ids past n_valid may be anything in [0, P) — their
+        # gathered rows are masked back to the current contents below
+        pages = pleaf[jnp.clip(page_ids, 0, pleaf.shape[0] - 1)]
+        flat = pages.transpose(1, 0, 2, 3).reshape(
+            leaf.shape[1], m * p, leaf.shape[3])
+        cur = jax.lax.dynamic_slice(
+            leaf, (slot, 0, 0, 0), (1,) + leaf.shape[1:])[0]
+        mask = (jnp.arange(m * p) < n_valid * p)[None, :, None]
+        row = jnp.where(mask, flat, cur)
+        return jax.lax.dynamic_update_slice(leaf, row[None],
+                                            (slot, 0, 0, 0))
+
+    return jax.tree_util.tree_map_with_path(per_leaf, cache)
+
+
+def cache_save_pages(cache, pool, slot, page_ids):
+    """The paged WRITE view: scatter slot ``slot``'s cache row, split into
+    pages, to pool entries ``page_ids`` in ONE fixed-shape op. Page ``j``
+    lands at ``page_ids[j]``; point unwanted pages at an out-of-range id
+    (``>= P``) — drop-mode scatter discards them, the fixed-shape spelling
+    of "save only the new pages". Returns the updated pool."""
+    def per_leaf(path, pleaf):
+        if not _paged_leaf_check(_cache_leaf_name(path)):
+            return pleaf
+        leaf = _get_by_path(cache, path)
+        p = pleaf.shape[2]
+        m = leaf.shape[2] // p
+        row = jax.lax.dynamic_slice(
+            leaf, (slot, 0, 0, 0), (1,) + leaf.shape[1:])[0]
+        pages = row.reshape(leaf.shape[1], m, p,
+                            leaf.shape[3]).transpose(1, 0, 2, 3)
+        return pleaf.at[page_ids].set(pages, mode="drop")
+
+    return jax.tree_util.tree_map_with_path(per_leaf, pool)
 
 
 def generate_beam(model: GPT, params, prompt: jax.Array, n_new: int, *,

@@ -2,15 +2,24 @@
 
 The training side of the framework has carried every PR so far; this
 package is the serving side the ROADMAP north star ("serves heavy traffic
-from millions of users") actually asks for. Three layers:
+from millions of users") actually asks for. Five layers:
 
 - :mod:`dtf_tpu.serve.engine` — ``DecodeEngine``: KV cache + per-slot
   positions/rng/sampling-params as persistent sharded device state, with
   exactly TWO AOT-compiled fixed-shape programs (``prefill_into_slot``,
-  ``decode_all``). Zero steady-state recompiles by construction.
+  ``decode_all``), plus an optional prefix page pool with two more
+  (``page_save``/``page_load``). Zero steady-state recompiles by
+  construction.
+- :mod:`dtf_tpu.serve.pages` — the block-granular prefix KV cache:
+  fixed-size pages with refcounts and LRU eviction, keyed by token-hash
+  with exact-match verification, so shared prompt stems prefill once.
 - :mod:`dtf_tpu.serve.scheduler` — request queue, FIFO admission with
-  prefill/decode interleave, slot allocation, EOS/max-len eviction, and
-  TTFT / per-token-latency / queue-depth / occupancy metrics.
+  prefill/page-load/decode interleave, slot allocation, EOS/max-len
+  eviction, and TTFT / per-token-latency / queue-depth / occupancy /
+  SLO metrics.
+- :mod:`dtf_tpu.serve.router` — ``Router``: N engine replicas (one shared
+  param tree, independent KV state) behind least-occupancy admission with
+  queue-depth tiebreak, ``router_wait`` spans and per-replica SLO rollups.
 - :mod:`dtf_tpu.serve.client` — in-process submit/poll API plus a seeded
   Poisson load generator for benching.
 
@@ -19,7 +28,10 @@ docs/SERVING.md walks the architecture and the fixed-shape rules.
 
 from dtf_tpu.serve.client import PoissonLoadGen, ServeClient, replay
 from dtf_tpu.serve.engine import DecodeEngine, decode_step_view
+from dtf_tpu.serve.pages import PrefixIndex
+from dtf_tpu.serve.router import Router
 from dtf_tpu.serve.scheduler import Request, Scheduler
 
-__all__ = ["DecodeEngine", "PoissonLoadGen", "Request", "Scheduler",
-           "ServeClient", "decode_step_view", "replay"]
+__all__ = ["DecodeEngine", "PoissonLoadGen", "PrefixIndex", "Request",
+           "Router", "Scheduler", "ServeClient", "decode_step_view",
+           "replay"]

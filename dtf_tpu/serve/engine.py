@@ -26,7 +26,16 @@ Exactly two jitted programs exist, both AOT-compiled at construction:
   per-slot rng stream (vmapped split-then-pick, the batch-1 ``generate``
   stream per slot).
 
-Because both programs are compiled executables, steady state CANNOT
+With ``prefix_pages > 0`` the engine additionally keeps a device **page
+pool** and two more AOT programs, ``page_save``/``page_load`` (fixed-shape
+BATCHED copies of a slot's page set to/from the pool, one dispatch per
+admission — see :mod:`dtf_tpu.serve.pages` and
+:func:`dtf_tpu.models.gpt.cache_load_pages`); the decode/prefill programs
+are untouched, so
+``trace_counts`` stays pinned at ``{prefill: 1, decode: 1}`` and the page
+programs carry their own ``page_trace_counts`` fence.
+
+Because all programs are compiled executables, steady state CANNOT
 recompile — a shape change would be a loud call-site error, not a silent
 retrace (``trace_counts`` exposes the per-program trace counters the fence
 test pins). State donation is deliberately off: on backfilled pre-0.5 jax a
@@ -165,15 +174,21 @@ def _build_decode_fn(model: gpt.GPT):
 
 def _build_prefill_fn(model: gpt.GPT):
     """prefill_into_slot: one fixed-width chunk into one slot; on the last
-    chunk, sample the request's first token (generate's split-then-pick)."""
-    def prefill_fn(params, state, slot, chunk, n_valid, reset, is_last,
-                   temp, top_k, top_p, eos, pad, key):
+    chunk, sample the request's first token (generate's split-then-pick).
+    ``start`` is the number of already-valid leading positions (0 for a
+    plain request; the prefix-page count × page size after page loads) —
+    the reset lands the slot's index there, so the live chunks CONTINUE
+    the loaded pages exactly like offline chunked prefill continues an
+    advanced cache."""
+    def prefill_fn(params, state, slot, start, chunk, n_valid, reset,
+                   is_last, temp, top_k, top_p, eos, pad, key):
         cache = state["cache"]
         row = _slice_slot_cache(cache, slot)
-        # a fresh request starts at index 0; stale slot contents need no
-        # clearing (validity is derived from the index — gpt.py docstring)
+        # a fresh request starts at index `start` (0 without prefix pages;
+        # stale slot contents past it need no clearing — validity is
+        # derived from the index, gpt.py docstring)
         row = jax.tree_util.tree_map_with_path(
-            lambda p, x: jnp.where(reset, jnp.zeros_like(x), x)
+            lambda p, x: jnp.where(reset, jnp.asarray(start, x.dtype), x)
             if _leaf_name(p) == "cache_index" else x, row)
         logits, mut = model.apply(
             {"params": params, "cache": row}, chunk[None, :],
@@ -209,6 +224,40 @@ def _build_prefill_fn(model: gpt.GPT):
         return new_state, {"token": tok_new, "done": done_new}
 
     return prefill_fn
+
+
+def _build_page_save_fn(n_pages: int):
+    """page_save: scatter the NEW pages of one slot's prompt — page j in
+    ``[lo, hi)`` lands at pool entry ``page_ids[j]`` — in one dispatch
+    (a per-page program would pay as much host overhead as the prefill
+    chunks the cache saves). Pages outside the window are pointed at the
+    out-of-range sentinel, which drop-mode scatter discards."""
+    def save_fn(state, pool, slot, page_ids, lo, hi):
+        m = page_ids.shape[0]
+        j = jnp.arange(m)
+        ids = jnp.where((j >= lo) & (j < hi), page_ids, n_pages)
+        return gpt.cache_save_pages(state["cache"], pool, slot, ids)
+
+    return save_fn
+
+
+def _build_page_load_fn():
+    """page_load: gather a whole pinned page chain (``page_ids[:n_valid]``)
+    into the leading positions of one slot — and DEACTIVATE the slot. The
+    deactivate matters: a freshly admitted slot still carries its previous
+    occupant's ``active``/index rows, and a decode_all running before the
+    first live chunk would otherwise keep writing the old request's
+    garbage K/V over the pages just landed."""
+    def load_fn(state, pool, slot, page_ids, n_valid):
+        return {
+            **state,
+            "cache": gpt.cache_load_pages(state["cache"], pool, slot,
+                                          page_ids, n_valid),
+            "active": state["active"].at[slot].set(False),
+            "done": state["done"].at[slot].set(False),
+        }
+
+    return load_fn
 
 
 def _state_struct(cfg: gpt.GPTConfig, n_slots: int,
@@ -263,7 +312,8 @@ class DecodeEngine:
 
     def __init__(self, cfg: gpt.GPTConfig, params: PyTree, *, n_slots: int,
                  max_len: int, prefill_chunk: int = 16,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None, kv_page_size: int = 0,
+                 prefix_pages: int = 0, page_save_after: int = 2):
         if n_slots < 1:
             raise ValueError(f"n_slots={n_slots} must be >= 1")
         if max_len < 2:
@@ -274,6 +324,21 @@ class DecodeEngine:
                 f"prefill_chunk={prefill_chunk} must be >= 2: a 1-token "
                 "apply routes to the single-token decode branch, not the "
                 "chunked-prefill path")
+        if prefix_pages:
+            if kv_page_size < 1:
+                raise ValueError(
+                    f"prefix_pages={prefix_pages} needs kv_page_size >= 1 "
+                    f"(got {kv_page_size})")
+            if max_len % kv_page_size:
+                raise ValueError(
+                    f"kv_page_size={kv_page_size} does not divide the "
+                    f"cache length max_len={max_len}: a page window "
+                    "crossing the cache end cannot be copied fixed-shape")
+            if cfg.attn_window:
+                raise ValueError(
+                    f"the prefix page cache needs the plain slot=position "
+                    f"cache layout; attn_window={cfg.attn_window} rolls "
+                    "the buffer so page windows alias arbitrary positions")
         base = dataclasses.replace(cfg, decode_len=max_len,
                                    slot_decode=False, chunked_prefill=False)
         # the chunk may not be wider than ANY layer's cache: the rolling-
@@ -294,7 +359,14 @@ class DecodeEngine:
         self.n_slots = n_slots
         self.max_len = max_len
         self.prefill_chunk = prefill_chunk
+        self.page_size = kv_page_size if prefix_pages else 0
+        self.n_pages = prefix_pages
         self.mesh = mesh
+        #: host-side call counters (plain ints — zero device readbacks):
+        #: the bench/telemetry surface for "how much prefill work ran".
+        self.counters = {"prefill_chunks": 0, "decode_steps": 0,
+                         "pages_loaded": 0, "pages_saved": 0,
+                         "prefix_hit_tokens": 0, "prefix_miss_tokens": 0}
         if mesh is None:
             # a restored checkpoint carries the TRAINING mesh's shardings;
             # unsharded serving runs on one device, and the AOT-compiled
@@ -360,10 +432,48 @@ class DecodeEngine:
             abs_params, abs_state).compile()
         self._prefill_c = jax.jit(counted("prefill", prefill_fn),
                                   **jit_kw).lower(
-            abs_params, abs_state, s_i32,
+            abs_params, abs_state, s_i32, s_i32,
             jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32), s_i32,
             s_bool, s_bool, s_f32, s_i32, s_f32, s_i32, s_i32,
             jax.ShapeDtypeStruct((2,), jnp.uint32)).compile()
+
+        #: the prefix page cache (None unless prefix_pages > 0): device
+        #: pool + host index + two more AOT programs with their own trace
+        #: fence — trace_counts itself stays pinned at {prefill, decode}.
+        self._prefix: Optional["pages_lib.PrefixIndex"] = None
+        self.page_trace_counts = {}
+        if prefix_pages:
+            from dtf_tpu.serve import pages as pages_lib
+
+            pool_abs = pages_lib.pool_abstract(
+                abs_state["cache"], prefix_pages, kv_page_size, mesh)
+            self._pages = _zeros_like_struct(pool_abs)
+            self._prefix = pages_lib.PrefixIndex(
+                prefix_pages, kv_page_size, save_after=page_save_after)
+            self.page_trace_counts = {"save": 0, "load": 0}
+
+            def pcounted(name, fn):
+                def wrapped(*args):
+                    self.page_trace_counts[name] += 1
+                    return fn(*args)
+                return wrapped
+
+            save_kw, load_kw = {}, {}
+            if mesh is not None:
+                save_kw["out_shardings"] = jax.tree.map(
+                    lambda s: s.sharding, pool_abs)
+                load_kw["out_shardings"] = jax.tree.map(
+                    lambda s: s.sharding, abs_state)
+            ids_abs = jax.ShapeDtypeStruct((max_len // kv_page_size,),
+                                           jnp.int32)
+            self._page_save_c = jax.jit(
+                pcounted("save", _build_page_save_fn(prefix_pages)),
+                **save_kw).lower(
+                abs_state, pool_abs, s_i32, ids_abs, s_i32, s_i32).compile()
+            self._page_load_c = jax.jit(
+                pcounted("load", _build_page_load_fn()),
+                **load_kw).lower(
+                abs_state, pool_abs, s_i32, ids_abs, s_i32).compile()
 
     # ------------------------------------------------------------- host API
 
@@ -371,47 +481,58 @@ class DecodeEngine:
         return math.ceil(prompt_len / self.prefill_chunk)
 
     def prefill_chunk_into(self, slot: int, prompt: Sequence[int],
-                           chunk_i: int, *, temperature: float = 0.0,
+                           chunk_i: int, *, start: int = 0,
+                           temperature: float = 0.0,
                            top_k: int = 0, top_p: float = 1.0,
                            eos_id: Optional[int] = None, pad_id: int = 0,
                            seed: int = 0) -> Optional[tuple[int, bool]]:
         """Run prompt chunk ``chunk_i`` of a request into ``slot`` — the
         scheduler's prefill/decode interleave granularity (decode_all may
         run between chunks; the slot stays a masked spectator until its
-        last chunk lands). Returns ``(first_token, done)`` on the last
-        chunk, None before."""
+        last chunk lands). ``start`` leading tokens are taken as already
+        in the slot's cache (prefix pages loaded via
+        :meth:`load_prefix_page`) — chunks cover ``prompt[start:]`` only.
+        Returns ``(first_token, done)`` on the last chunk, None before."""
         prompt = list(int(t) for t in prompt)
         if not 1 <= len(prompt) <= self.max_len - 1:
             raise ValueError(
                 f"prompt length {len(prompt)} must be in [1, "
                 f"{self.max_len - 1}] (max_len={self.max_len} covers "
                 "prompt + generated tokens)")
+        if not 0 <= start < len(prompt):
+            raise ValueError(
+                f"start={start} must be in [0, {len(prompt)}) — at least "
+                "one prompt token must prefill live (the request's first "
+                "sampled token comes from the last position's logits)")
         if not 0 <= slot < self.n_slots:
             raise ValueError(f"slot {slot} out of range [0, {self.n_slots})")
         c = self.prefill_chunk
-        n = self.n_chunks(len(prompt))
+        tail = prompt[start:]
+        n = self.n_chunks(len(tail))
         if not 0 <= chunk_i < n:
             raise ValueError(f"chunk {chunk_i} out of range [0, {n})")
-        seg = prompt[chunk_i * c:(chunk_i + 1) * c]
+        seg = tail[chunk_i * c:(chunk_i + 1) * c]
         buf = np.zeros((c,), np.int32)
         buf[:len(seg)] = seg
         last = chunk_i == n - 1
         self._state, out = self._prefill_c(
-            self._params, self._state, np.int32(slot), buf,
-            np.int32(len(seg)), np.bool_(chunk_i == 0), np.bool_(last),
-            np.float32(temperature), np.int32(top_k), np.float32(top_p),
-            np.int32(-1 if eos_id is None else eos_id), np.int32(pad_id),
+            self._params, self._state, np.int32(slot), np.int32(start),
+            buf, np.int32(len(seg)), np.bool_(chunk_i == 0),
+            np.bool_(last), np.float32(temperature), np.int32(top_k),
+            np.float32(top_p), np.int32(-1 if eos_id is None else eos_id),
+            np.int32(pad_id),
             np.asarray(jax.random.PRNGKey(seed), np.uint32))
+        self.counters["prefill_chunks"] += 1
         if not last:
             return None
         return int(out["token"]), bool(out["done"])
 
-    def prefill(self, slot: int, prompt: Sequence[int],
+    def prefill(self, slot: int, prompt: Sequence[int], *, start: int = 0,
                 **sampling) -> tuple[int, bool]:
-        """Admit a request into ``slot``: stream its whole prompt through
-        the compiled chunk program and sample the first token. Returns
-        ``(first_token, done)``."""
-        n = self.n_chunks(len(prompt))
+        """Admit a request into ``slot``: stream its whole prompt (minus
+        ``start`` page-loaded tokens) through the compiled chunk program
+        and sample the first token. Returns ``(first_token, done)``."""
+        n = self.n_chunks(len(prompt) - start)
         if n == 0:
             # the per-chunk validation never runs on an empty prompt —
             # fail here, not with a None return at the caller's unpack
@@ -419,7 +540,8 @@ class DecodeEngine:
                 f"prompt length 0 must be in [1, {self.max_len - 1}]")
         out = None
         for i in range(n):
-            out = self.prefill_chunk_into(slot, prompt, i, **sampling)
+            out = self.prefill_chunk_into(slot, prompt, i, start=start,
+                                          **sampling)
         return out
 
     def decode(self) -> tuple[np.ndarray, np.ndarray]:
@@ -428,12 +550,112 @@ class DecodeEngine:
         device→host sync per generated token (EOS and delivery decisions
         live on the host)."""
         self._state, out = self._decode_c(self._params, self._state)
+        self.counters["decode_steps"] += 1
         return np.asarray(out["token"]), np.asarray(out["done"])
 
+    # ----------------------------------------------------- prefix page API
+
+    def prefix_match(self, prompt: Sequence[int]):
+        """Admission-time lookup: the longest cached page chain exactly
+        matching a prefix of ``prompt``, PINNED until
+        :meth:`release_prefix` (the scheduler releases on slot evict).
+        None on a miss or with the page cache off."""
+        if self._prefix is None:
+            return None
+        prompt = tuple(int(t) for t in prompt)
+        h = self._prefix.acquire(prompt)
+        if h is None:
+            self.counters["prefix_miss_tokens"] += len(prompt)
+        else:
+            self.counters["prefix_hit_tokens"] += h.n_tokens
+            self.counters["prefix_miss_tokens"] += len(prompt) - h.n_tokens
+        return h
+
+    def _ids_buf(self, ids: Sequence[int]) -> np.ndarray:
+        buf = np.zeros((self.max_len // self.page_size,), np.int32)
+        buf[:len(ids)] = ids
+        return buf
+
+    def load_prefix(self, slot: int, handle) -> None:
+        """Gather a pinned chain's pages into ``slot``'s leading cache
+        positions — ONE compiled dispatch for the whole chain, replacing
+        ``n_tokens/prefill_chunk`` transformer chunks of prefill work (the
+        saving the page cache exists for; a per-page spelling would give
+        most of it back as host dispatch overhead)."""
+        ids = [e.page_id for e in handle.entries]
+        self._state = self._page_load_c(
+            self._state, self._pages, np.int32(slot), self._ids_buf(ids),
+            np.int32(len(ids)))
+        self.counters["pages_loaded"] += len(ids)
+
+    def save_prefix_pages(self, slot: int, prompt: Sequence[int]) -> None:
+        """After a request's LAST prefill chunk: register every full page
+        of its prompt not yet in the pool and scatter them out of the
+        slot's freshly written KV — one dispatch however many pages are
+        new. Stops silently when the pool is exhausted by pinned/parented
+        pages — saving is an optimization, never a blocker."""
+        if self._prefix is None:
+            return
+        prompt = tuple(int(t) for t in prompt)
+        full = len(prompt) // self.page_size
+        have, parent = self._prefix.longest(prompt, cap=full)
+        # save admission: only prefixes traffic has repeated are worth a
+        # dispatch — a unique tail page would cost host overhead and a
+        # pool slot for KV nobody will ever hit (pages.py docstring)
+        full = have + self._prefix.save_eligible(prompt, have, full)
+        ids = []
+        for i in range(have, full):
+            ent = self._prefix.reserve(prompt[:(i + 1) * self.page_size],
+                                       parent)
+            if ent is None:
+                break
+            ids.append(ent.page_id)
+            parent = ent
+        if not ids:
+            return
+        buf = self._ids_buf([0] * have + ids)
+        self._pages = self._page_save_c(
+            self._state, self._pages, np.int32(slot), buf, np.int32(have),
+            np.int32(have + len(ids)))
+        self.counters["pages_saved"] += len(ids)
+
+    def release_prefix(self, handle) -> None:
+        """Unpin an admission chain (call exactly once, on slot evict)."""
+        if handle is not None:
+            self._prefix.release(handle)
+
+    def warm_page_programs(self) -> None:
+        """Run both page programs once with no-op operands (n_valid=0
+        load, empty [lo, hi) save window) so first-call backend overhead
+        lands outside any timed window — the bench A/B warms every
+        program before its measured section, and this keeps the calling
+        convention next to the programs it warms instead of spelled out
+        in the bench. No cache row or pool page changes. No-op with the
+        cache off."""
+        if self._prefix is None:
+            return
+        buf = self._ids_buf([])
+        self._state = self._page_load_c(self._state, self._pages,
+                                        np.int32(0), buf, np.int32(0))
+        self._pages = self._page_save_c(self._state, self._pages,
+                                        np.int32(0), buf, np.int32(0),
+                                        np.int32(0))
+
+    def prefix_stats(self) -> dict:
+        """Page-cache aggregates (empty dict with the cache off)."""
+        if self._prefix is None:
+            return {}
+        return {**self._prefix.stats,
+                "pages": self.n_pages - self._prefix.n_free,
+                "pages_free": self._prefix.n_free}
+
     def cache_bytes(self) -> int:
-        """Resident KV-cache footprint (all slots, all layers)."""
+        """Resident KV footprint: slot cache + page pool, all layers."""
+        leaves = jax.tree.leaves(self._state["cache"])
+        if self._prefix is not None:
+            leaves += jax.tree.leaves(self._pages)
         return sum(int(np.prod(x.shape)) * x.dtype.itemsize
-                   for x in jax.tree.leaves(self._state["cache"]))
+                   for x in leaves)
 
 
 def decode_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,

@@ -1,0 +1,287 @@
+"""Block-granular prefix KV page cache — prefill shared prompt stems ONCE.
+
+Shared prompt stems (system prompts, few-shot headers) are the dominant
+redundant work in production serving: every request re-runs the same
+transformer prefill over the same leading tokens. This module is the reuse
+layer the Gemma-on-TPU serving comparison (PAPERS.md, arxiv 2605.25645)
+names as the first lever: the engine keeps a device-resident **page pool**
+— fixed-size windows of KV (every batch-led cache leaf, int8 scales
+included, one page id spanning ALL layers) — and this host-side
+:class:`PrefixIndex` maps *whole prefixes* to chains of pages.
+
+Design rules (the fixed-shape discipline of docs/SERVING.md, extended):
+
+- A page covers token positions ``[i*page_size, (i+1)*page_size)`` of a
+  request that started at position 0 — positions are absolute, so RoPE'd
+  K/V is bit-reusable by any request whose prefix TOKENS match exactly.
+- The cache key is the token-hash of the **entire prefix** through the
+  page (KV at position t depends on every token <= t, so a page keyed by
+  only its own tokens would alias different contexts); lookups verify the
+  stored token tuple exactly — a hash collision can never serve wrong KV.
+- Entries form parent chains (the page for prefix length ``2p`` holds a
+  ref on the page for length ``p``), and in-flight requests pin the chain
+  they are loading — eviction (LRU) only ever takes an unpinned,
+  childless entry, so a page can never be overwritten mid-copy.
+- Pages are COPIED into a slot's private cache on admission (ONE compiled
+  gather for the whole chain, not a transformer forward) and copied out of
+  a slot after a miss prefill — decode itself never touches the pool, so
+  the fenced ``gpt_serve`` decode graph is byte-identical with the cache
+  on or off.
+- **Save admission**: a page is only copied OUT once its prefix has been
+  seen ``save_after`` times (default 2). Eagerly caching every full page
+  would spend a save dispatch on each request's unique tail — pool
+  pollution plus host overhead that can exceed the prefill work saved;
+  the second-sighting rule caches exactly the prefixes traffic repeats.
+
+The device half (pool state + the two AOT page programs) lives in
+``engine.py``; :func:`pool_abstract` here builds the pool's abstract
+struct from the engine's cache struct so the two cannot desynchronize.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dtf_tpu.models import gpt
+
+PyTree = Any
+
+
+def pool_abstract(cache_struct: PyTree, n_pages: int, page_size: int,
+                  mesh=None) -> PyTree:
+    """Abstract page-pool tree derived from the engine's cache struct:
+    every batch-led ``[S, H, L, D]`` leaf becomes ``[P, H, page, D]`` at
+    the same tree path (int8 caches bring their scale leaves along
+    automatically); ``cache_index`` is dropped — a page's position range
+    is host bookkeeping. With ``mesh``, heads shard over ``'model'`` like
+    the cache itself (page copies stay local per TP shard) while the page
+    axis replicates — slots shard over ``'data'``, so the slot gather is
+    the same known resharding cost as sharded prefill (docs/SERVING.md)."""
+    out: dict = {}
+    for path, s in jax.tree_util.tree_flatten_with_path(cache_struct)[0]:
+        name = gpt._cache_leaf_name(path)
+        if name in gpt._NON_BATCH_CACHE_KEYS:
+            continue
+        if name not in gpt._BATCH_LED_CACHE_KEYS:
+            raise ValueError(f"unknown cache leaf {name!r} (see "
+                             "gpt._BATCH_LED_CACHE_KEYS)")
+        shape = (n_pages, s.shape[1], page_size, s.shape[3])
+        sh = (NamedSharding(mesh, P(None, "model", None, None))
+              if mesh is not None else None)
+        gpt._set_by_path(out, path,
+                         jax.ShapeDtypeStruct(shape, s.dtype, sharding=sh))
+    return out
+
+
+@dataclasses.dataclass
+class _Entry:
+    """One cached page: ``tokens`` is the WHOLE prefix through this page
+    (exact-match verification), ``refs`` counts children + live pins."""
+
+    page_id: int
+    tokens: tuple
+    parent: Optional["_Entry"]
+    refs: int = 0
+    last_use: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class PrefixHandle:
+    """A pinned chain of pages covering ``n_tokens`` leading prompt
+    tokens, root→leaf; hold it for the lifetime of the request and
+    release exactly once (the deepest entry carries the pin)."""
+
+    entries: tuple
+    n_tokens: int
+
+
+class PrefixIndex:
+    """Host index over the page pool: token-hash keyed, exact-verified,
+    refcounted, LRU-evicting. Pure bookkeeping — never touches a device
+    value (the engine runs the compiled copies).
+
+    ``hash_fn`` is injectable so tests can force collisions and prove the
+    exact-match verification actually carries correctness.
+    """
+
+    def __init__(self, n_pages: int, page_size: int, *,
+                 save_after: int = 2,
+                 hash_fn: Callable[[tuple], int] = hash):
+        if n_pages < 1:
+            raise ValueError(f"n_pages={n_pages} must be >= 1")
+        if page_size < 1:
+            raise ValueError(f"page_size={page_size} must be >= 1")
+        if save_after < 1:
+            raise ValueError(f"save_after={save_after} must be >= 1")
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self.save_after = save_after
+        self._hash = hash_fn
+        self._by_hash: dict[int, list[_Entry]] = {}
+        self._free = list(range(n_pages))
+        self._clock = 0
+        #: sightings of not-yet-cached prefixes (the save-admission
+        #: filter) — bounded so a long unique-prompt stream cannot grow
+        #: host memory.
+        self._seen: "collections.OrderedDict[tuple, int]" = (
+            collections.OrderedDict())
+        self._seen_cap = 16 * n_pages
+        # token-level hit/miss totals live on the ENGINE's counters (one
+        # writer): a second copy here would collide with them in the
+        # scheduler's serve_prefix_* stats namespace and drift whenever
+        # one side is reset (the bench resets engine counters at warm-up)
+        self.stats = {"hits": 0, "misses": 0, "evictions": 0}
+
+    # ------------------------------------------------------------- lookup
+
+    def _find(self, tokens: tuple) -> Optional[_Entry]:
+        for e in self._by_hash.get(self._hash(tokens), ()):
+            if e.tokens == tokens:        # exact-match verification
+                return e
+        return None
+
+    def longest(self, prompt: Sequence[int],
+                cap: Optional[int] = None) -> tuple[int, Optional[_Entry]]:
+        """Longest registered page chain covering a prefix of ``prompt``:
+        ``(n_pages, deepest entry)``. ``cap`` bounds the page count (the
+        engine caps admission reuse at ``(len-1)//page`` so at least one
+        prompt token always runs live — the first sampled token needs the
+        last position's logits)."""
+        p = self.page_size
+        top = len(prompt) // p if cap is None else cap
+        for k in range(top, 0, -1):
+            e = self._find(tuple(prompt[:k * p]))
+            if e is not None:
+                return k, e
+        return 0, None
+
+    def acquire(self, prompt: Sequence[int]) -> Optional[PrefixHandle]:
+        """Pin the longest reusable chain for ``prompt`` (admission-time
+        lookup). None on a miss; on a hit the DEEPEST entry takes one pin
+        (its ancestors are already held alive by child refs)."""
+        cap = max(0, (len(prompt) - 1) // self.page_size)
+        k, e = self.longest(prompt, cap=cap)
+        if e is None:
+            self.stats["misses"] += 1
+            return None
+        chain: list[_Entry] = []
+        node: Optional[_Entry] = e
+        while node is not None:
+            chain.append(node)
+            node = node.parent
+        chain.reverse()
+        assert len(chain) == k, (len(chain), k)
+        self._clock += 1
+        for n in chain:
+            n.last_use = self._clock
+        e.refs += 1
+        self.stats["hits"] += 1
+        return PrefixHandle(entries=tuple(chain),
+                            n_tokens=k * self.page_size)
+
+    def release(self, handle: PrefixHandle) -> None:
+        handle.entries[-1].refs -= 1
+        assert handle.entries[-1].refs >= 0
+
+    # ------------------------------------------------------------ reserve
+
+    def save_eligible(self, prompt: Sequence[int], have: int,
+                      full: int) -> int:
+        """The save-admission filter: bump the sighting count of every
+        not-yet-cached full-page prefix of ``prompt`` (pages ``have`` to
+        ``full``) and return how many CONTIGUOUS pages from ``have`` have
+        now been seen ``save_after`` times — only those are worth a save
+        dispatch (a unique tail never reaches the threshold, so it costs
+        nothing and pollutes nothing). Chains must stay contiguous: the
+        first unpopular page stops eligibility, deeper pages just record
+        their sighting."""
+        p = self.page_size
+        eligible, counting = 0, True
+        for i in range(have, full):
+            prefix = tuple(prompt[:(i + 1) * p])
+            c = self._seen.pop(prefix, 0) + 1
+            self._seen[prefix] = c               # re-insert = LRU refresh
+            while len(self._seen) > self._seen_cap:
+                self._seen.popitem(last=False)
+            if counting and c >= self.save_after:
+                eligible += 1
+            else:
+                counting = False
+        return eligible
+
+    def reserve(self, prefix: tuple,
+                parent: Optional[_Entry]) -> Optional[_Entry]:
+        """Allocate a page for ``prefix`` (registering it immediately) —
+        from the free list, else by evicting the LRU unpinned childless
+        entry. None when every page is pinned or parented (the save is
+        skipped, never blocked). ``parent`` must be the entry for
+        ``prefix`` minus one page (None for the first page)."""
+        if len(prefix) != (0 if parent is None
+                           else len(parent.tokens)) + self.page_size:
+            raise ValueError(
+                f"prefix of {len(prefix)} tokens does not extend parent "
+                f"({0 if parent is None else len(parent.tokens)}) by one "
+                f"{self.page_size}-token page")
+        if self._find(prefix) is not None:
+            raise ValueError("prefix already registered; look it up "
+                             "instead of reserving a duplicate page")
+        if self._free:
+            pid = self._free.pop()
+        else:
+            # `parent` may be a childless leaf (refs == 0) while the save
+            # loop extends it — evicting it here would free its page id
+            # into the pop() below and leave the new child holding a
+            # dangling parent whose pool slot now stores DIFFERENT KV; a
+            # later hit would walk that chain and serve wrong tokens.
+            # Deeper ancestors are safe (child refs pin them).
+            victim = min(
+                (e for es in self._by_hash.values() for e in es
+                 if e.refs == 0 and e is not parent),
+                key=lambda e: e.last_use, default=None)
+            if victim is None:
+                return None
+            self._evict(victim)
+            pid = self._free.pop()
+        self._clock += 1
+        e = _Entry(pid, prefix, parent, refs=0, last_use=self._clock)
+        if parent is not None:
+            parent.refs += 1
+        self._by_hash.setdefault(self._hash(prefix), []).append(e)
+        self._seen.pop(prefix, None)     # cached now — sightings done
+        return e
+
+    def _evict(self, e: _Entry) -> None:
+        es = self._by_hash[self._hash(e.tokens)]
+        es.remove(e)
+        if not es:
+            del self._by_hash[self._hash(e.tokens)]
+        if e.parent is not None:
+            e.parent.refs -= 1
+        self._free.append(e.page_id)
+        self.stats["evictions"] += 1
+
+    # ------------------------------------------------------------- report
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_entries(self) -> int:
+        return sum(len(v) for v in self._by_hash.values())
+
+    def pinned(self) -> int:
+        """Live pins across entries (children excluded) — 0 when every
+        admitted request has released its handle (slot-evict contract)."""
+        pins = 0
+        for es in self._by_hash.values():
+            for e in es:
+                kids = sum(1 for fs in self._by_hash.values()
+                           for f in fs if f.parent is e)
+                pins += e.refs - kids
+        return pins

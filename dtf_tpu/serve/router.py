@@ -1,0 +1,206 @@
+"""Multi-replica router — the serving tier above :class:`DecodeEngine`.
+
+One engine is one KV-cache pool on one device set; the ROADMAP's
+millions-of-users north star needs N of them behind one front door. A
+:class:`Router` owns N ``(DecodeEngine, Scheduler)`` replicas that SHARE
+one restored param tree (weights are read-only at serve time — N replicas
+cost N KV caches, not N param copies) while keeping fully independent KV
+state, and admits each request to the replica with the **least slot
+occupancy**, breaking ties by **queue depth** (then replica index, for
+determinism). Every replica keeps the engine's fixed-shape discipline:
+``trace_counts`` stays ``{prefill: 1, decode: 1}`` per replica and the
+``gpt_serve`` comms fence covers each replica's decode graph identically.
+
+Observability is the PR 5 span surface, serving edition:
+
+- ``router_wait`` — queue time between submit and a replica accepting the
+  request into a slot (recorded by the scheduler at admission; host
+  clocks only, zero added device readbacks);
+- per-replica TTFT/occupancy/SLO rollups in :meth:`Router.stats`
+  (``replica{i}_*`` keys) next to the fleet aggregates — ``ttft_slo_s``
+  sets the TTFT objective each replica reports compliance against.
+
+The router is drop-in for the scheduler in the pump loop: it exposes the
+same ``submit/tick/pending`` surface, so :func:`dtf_tpu.serve.client.replay`
+drives a fleet exactly like a single scheduler (the bench A/B rides this).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Sequence
+
+from dtf_tpu.metrics import quantile as _quantile
+from dtf_tpu.serve.engine import DecodeEngine
+from dtf_tpu.serve.scheduler import Request, Scheduler
+
+#: per-replica stat keys surfaced as ``replica{i}_<key>`` (the SLO panel);
+#: everything else stays per-scheduler to keep the JSON line bounded.
+_REPLICA_KEYS = ("serve_completed", "serve_occupancy_mean",
+                 "serve_ttft_p50_s", "serve_ttft_p99_s",
+                 "serve_queue_peak", "serve_ttft_slo_ok_frac")
+
+
+class Router:
+    """Least-occupancy admission over N engine replicas (module docstring).
+
+    Build from live engines (params already shared by construction — pass
+    the same tree to each) or via :meth:`build`. ``ttft_slo_s``/``clock``/
+    scheduler knobs apply to every replica's scheduler uniformly.
+    """
+
+    def __init__(self, engines: Sequence[DecodeEngine], writer=None, *,
+                 telemetry=None, ttft_slo_s: float = 0.0,
+                 clock=time.monotonic, **scheduler_kw):
+        if not engines:
+            raise ValueError("Router needs at least one engine replica")
+        self.telemetry = telemetry
+        self.schedulers = [
+            Scheduler(e, writer, telemetry=telemetry,
+                      ttft_slo_s=ttft_slo_s, clock=clock, **scheduler_kw)
+            for e in engines]
+        self.ttft_slo_s = ttft_slo_s
+        self._where: dict[int, tuple[int, int]] = {}
+        self._next_id = 0
+
+    @classmethod
+    def build(cls, cfg, params, *, n_replicas: int, n_slots: int,
+              max_len: int, prefill_chunk: int = 16, mesh=None,
+              kv_page_size: int = 0, prefix_pages: int = 0,
+              page_save_after: int = 2, **router_kw) -> "Router":
+        """N identical replicas over ONE param tree. Each replica gets its
+        own KV state (and page pool, when enabled) and its own pair of AOT
+        programs; the params device arrays are shared."""
+        if n_replicas < 1:
+            raise ValueError(f"n_replicas={n_replicas} must be >= 1")
+        engines = [DecodeEngine(cfg, params, n_slots=n_slots,
+                                max_len=max_len,
+                                prefill_chunk=prefill_chunk, mesh=mesh,
+                                kv_page_size=kv_page_size,
+                                prefix_pages=prefix_pages,
+                                page_save_after=page_save_after)
+                   for _ in range(n_replicas)]
+        return cls(engines, **router_kw)
+
+    # ------------------------------------------------------------ admission
+
+    def _pick(self) -> int:
+        """Least occupancy; queue depth breaks the tie (every replica
+        saturated → the shortest line), replica index breaks that
+        (deterministic tests)."""
+        return min(range(len(self.schedulers)),
+                   key=lambda i: (self.schedulers[i].occupancy,
+                                  self.schedulers[i].queue_depth, i))
+
+    def submit(self, req: Request) -> int:
+        i = self._pick()
+        local = self.schedulers[i].submit(req)
+        rid = self._next_id
+        self._next_id += 1
+        self._where[rid] = (i, local)
+        return rid
+
+    def replica_of(self, rid: int) -> int:
+        """Which replica holds request ``rid`` (admission audit)."""
+        return self._where[rid][0]
+
+    # ----------------------------------------------------------- pump surface
+
+    @property
+    def pending(self) -> int:
+        return sum(s.pending for s in self.schedulers)
+
+    def tick(self) -> None:
+        """One scheduling round on every replica with work — replicas are
+        independent KV state, so their ticks never contend for slots."""
+        for s in self.schedulers:
+            if s.pending:
+                s.tick()
+
+    def run_until_idle(self, max_ticks: int = 100000) -> None:
+        for _ in range(max_ticks):
+            if not self.pending:
+                return
+            self.tick()
+        raise RuntimeError(f"requests still pending after {max_ticks} ticks")
+
+    def poll(self, rid: int) -> dict:
+        i, local = self._where[rid]
+        return self.schedulers[i].poll(local)
+
+    def result(self, rid: int, max_ticks: int = 100000) -> list[int]:
+        for _ in range(max_ticks):
+            st = self.poll(rid)
+            if st["status"] == "done":
+                return st["tokens"]
+            self.tick()
+        raise RuntimeError(f"request {rid} not done after {max_ticks} ticks")
+
+    def release(self, rid: int) -> None:
+        i, local = self._where.pop(rid)
+        self.schedulers[i].release(local)
+
+    def drain(self) -> None:
+        self.run_until_idle()
+
+    # --------------------------------------------------------------- metrics
+
+    def trace_counts(self) -> list[dict]:
+        """Per-replica program trace counters (page fences merged in) —
+        the steady-state recompile pin, fleet edition."""
+        return [{**s.engine.trace_counts,
+                 **{f"page_{k}": v
+                    for k, v in s.engine.page_trace_counts.items()}}
+                for s in self.schedulers]
+
+    def stats(self, brief: bool = False) -> dict:
+        """Fleet aggregates + the ``replica{i}_*`` SLO panel."""
+        n = len(self.schedulers)
+        out = {
+            "router_replicas": float(n),
+            "router_completed": float(sum(s._completed
+                                          for s in self.schedulers)),
+            "router_queue_depth": float(sum(s.queue_depth
+                                            for s in self.schedulers)),
+            "router_occupancy": (sum(s.occupancy for s in self.schedulers)
+                                 / n),
+        }
+        if brief:
+            return out
+        ttfts = [t for s in self.schedulers for t in s._ttfts]
+        out["router_ttft_p50_s"] = _quantile(ttfts, 0.5)
+        out["router_ttft_p99_s"] = _quantile(ttfts, 0.99)
+        if self.ttft_slo_s > 0.0:
+            out["router_ttft_slo_ok_frac"] = (
+                sum(1 for t in ttfts if t <= self.ttft_slo_s) / len(ttfts)
+                if ttfts else 1.0)
+        # fleet-summed engine counters (prefill chunks, page hits, ...)
+        counters: dict = {}
+        for s in self.schedulers:
+            for k, v in getattr(s.engine, "counters", {}).items():
+                counters[k] = counters.get(k, 0) + v
+        out.update({f"router_{k}": float(v) for k, v in counters.items()})
+        for i, s in enumerate(self.schedulers):
+            st = s.stats()
+            for k in _REPLICA_KEYS:
+                if k in st:
+                    out[f"replica{i}_{k}"] = st[k]
+        if self.telemetry is not None:
+            roll = self.telemetry.spans.rollup().get("router_wait")
+            if roll is not None:
+                out["router_wait_p50_s"] = roll["p50_s"]
+                out["router_wait_p99_s"] = roll["p99_s"]
+        return out
+
+
+def poisson_replay(router, arrivals, *, clock=time.perf_counter,
+                   sleep=time.sleep) -> float:
+    """:func:`dtf_tpu.serve.client.replay` works unchanged on a Router
+    (same submit/tick/pending surface) — re-exported here so fleet benches
+    read naturally."""
+    from dtf_tpu.serve.client import replay
+
+    return replay(router, arrivals, clock=clock, sleep=sleep)
+
+
+__all__ = ["Router", "poisson_replay"]

@@ -14,9 +14,21 @@ per-request TTFT and per-token latency on completion. ``stats()`` returns
 the same aggregates for benches (``scripts/serve_gpt.py`` prints them as
 its one JSON line). With a :class:`dtf_tpu.telemetry.Telemetry` attached
 the engine calls are additionally recorded as ``serve_prefill_chunk`` /
-``serve_decode`` phase spans (host wall time per compiled-program call —
-the training loop's data_wait/dispatch decomposition, serving edition) and
-``stats()`` gains their p50/p99.
+``serve_page_load`` / ``serve_page_save`` / ``serve_decode`` phase spans
+(host wall time per compiled-program call — the training loop's
+data_wait/dispatch decomposition, serving edition) plus ``router_wait``
+(queue time between submit and a slot accepting the request — the
+admission latency the Router SLO panel watches), and ``stats()`` gains
+their p50/p99. All of it is host clock arithmetic: zero added device
+readbacks (counter-instrumented test, PR 5 idiom).
+
+With an engine built with ``prefix_pages > 0`` admission consults the
+prefix page cache: the pinned page chain lands in ONE batched gather on
+the same ``prefill_chunks_per_tick`` budget as prompt chunks (one budget
+unit replacing ``n_cached/prefill_chunk`` chunks of transformer work),
+the live chunks continue at ``start = n_cached``, new full pages scatter
+back in one dispatch after the last chunk, and the pin is released on
+slot evict — the refcount contract of :mod:`dtf_tpu.serve.pages`.
 """
 
 from __future__ import annotations
@@ -54,6 +66,10 @@ class _Rec:
     submit_t: float = 0.0
     first_token_t: float = 0.0
     finish_t: float = 0.0
+    #: pinned prefix-page chain (engine.prefix_match) — pages loaded so
+    #: far, released on slot evict (the refcount contract).
+    handle: object = None
+    pages_loaded: int = 0
 
 
 class Scheduler:
@@ -66,11 +82,16 @@ class Scheduler:
 
     def __init__(self, engine, writer=None, *, log_every: int = 0,
                  prefill_chunks_per_tick: int = 4, clock=time.monotonic,
-                 completed_cap: int = 100_000, telemetry=None):
+                 completed_cap: int = 100_000, telemetry=None,
+                 ttft_slo_s: float = 0.0):
         self.engine = engine
         self.writer = writer
         self.log_every = log_every
         self.telemetry = telemetry
+        #: TTFT service-level objective (0 = untracked): ``stats()`` then
+        #: reports the fraction of completed first tokens inside it — the
+        #: per-replica SLO rollup the router surfaces (docs/SERVING.md).
+        self.ttft_slo_s = ttft_slo_s
         if prefill_chunks_per_tick < 0:
             # a negative budget would be truthy in tick()'s `or 10**9`
             # fallback yet fail `> 0` — admission silently off, replay()
@@ -143,17 +164,41 @@ class Scheduler:
                 rec.slot = self._free.pop(0)
                 rec.status = "prefill"
                 self._admitting = rec
+                # queue time before a replica accepts — the router_wait
+                # span (host clocks only: zero added device readbacks)
+                if self.telemetry is not None:
+                    self.telemetry.spans.add(
+                        "router_wait", self.clock() - rec.submit_t)
+                # prefix-page lookup at admission (None with the cache
+                # off): the pinned chain loads below, on the same budget
+                pm = getattr(self.engine, "prefix_match", None)
+                if pm is not None:
+                    rec.handle = pm(rec.req.prompt)
             rec = self._admitting
             r = rec.req
+            if rec.handle is not None and not rec.pages_loaded:
+                # the whole pinned chain lands in ONE compiled gather —
+                # n_tokens/chunk prefill chunks of work for one budget
+                # unit (it still spends budget so admission cannot starve
+                # decode, and the load deactivates the slot first)
+                self._timed("serve_page_load", self.engine.load_prefix,
+                            rec.slot, rec.handle)
+                rec.pages_loaded = len(rec.handle.entries)
+                budget -= 1
+                continue
+            start = rec.handle.n_tokens if rec.handle is not None else 0
             out = self._timed(
                 "serve_prefill_chunk", self.engine.prefill_chunk_into,
-                rec.slot, r.prompt, rec.chunks_done,
+                rec.slot, r.prompt, rec.chunks_done, start=start,
                 temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
                 eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed)
             rec.chunks_done += 1
             budget -= 1
             if out is not None:                      # last chunk: tok0
                 tok, done = out
+                save = getattr(self.engine, "save_prefix_pages", None)
+                if save is not None:
+                    self._timed("serve_page_save", save, rec.slot, r.prompt)
                 rec.first_token_t = self.clock()
                 rec.tokens.append(tok)
                 self._admitting = None
@@ -201,9 +246,26 @@ class Scheduler:
     def _occupancy(self) -> float:
         return 1.0 - len(self._free) / self.engine.n_slots
 
+    # -------------------------------------------------- router admission
+
+    @property
+    def occupancy(self) -> float:
+        """Occupied-slot fraction (prefilling slots included) — the
+        router's primary admission signal."""
+        return self._occupancy()
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests accepted but not yet in a slot — the router's
+        admission tiebreak."""
+        return len(self._queue) + (self._admitting is not None)
+
     def _finish(self, rec: _Rec) -> None:
         rec.status = "done"
         rec.finish_t = rec.finish_t or self.clock()
+        if rec.handle is not None:       # refcount release on slot evict
+            self.engine.release_prefix(rec.handle)
+            rec.handle = None
         if len(rec.tokens) > 1:
             self._tok_lats.append((rec.finish_t - rec.first_token_t)
                                   / (len(rec.tokens) - 1))
@@ -248,9 +310,20 @@ class Scheduler:
             "serve_tok_latency_p50_s": _quantile(self._tok_lats, 0.5),
             "serve_tok_latency_p99_s": _quantile(self._tok_lats, 0.99),
         })
+        if self.ttft_slo_s > 0.0:
+            out["serve_ttft_slo_ok_frac"] = (
+                sum(1 for t in self._ttfts if t <= self.ttft_slo_s)
+                / len(self._ttfts) if self._ttfts else 1.0)
+        counters = getattr(self.engine, "counters", None)
+        if counters is not None:
+            out.update({f"serve_{k}": float(v) for k, v in counters.items()})
+        prefix = getattr(self.engine, "prefix_stats", None)
+        if prefix is not None:
+            out.update({f"serve_prefix_{k}": float(v)
+                        for k, v in prefix().items()})
         if self.telemetry is not None:
             for name, roll in self.telemetry.spans.rollup().items():
-                if name.startswith("serve_"):
+                if name.startswith("serve_") or name == "router_wait":
                     out[f"{name}_p50_s"] = roll["p50_s"]
                     out[f"{name}_p99_s"] = roll["p99_s"]
         return out

@@ -23,7 +23,11 @@ needed (contrast scripts/bench_attention.py tpu_child).
 ``--sweep-serve``: the continuous-batching A/B (``child_serve``) — the
 dtf_tpu/serve engine vs a classic fixed-batch server under the same seeded
 Poisson arrivals; goodput tokens/sec + TTFT p50/p99 both sides, merged
-into ``BENCH_LM.json`` under ``"serve"``.
+into ``BENCH_LM.json`` under ``"serve"``. The sweep spans replica count
+(engines behind the Router, slots split so capacity is constant) and
+prefix-hit ratio (shared prompt stems; hit rows carry an extra
+``serve_off`` side — same arrivals, page cache off — so the prefill-work
+and TTFT p50 deltas are in-row).
 """
 
 import json
@@ -130,7 +134,15 @@ def child_serve():
     long-request-holds-the-batch cost this engine exists to remove).
     Prompt length is fixed per row (static batching cannot mix lengths);
     the generation lengths vary, which is the headline effect. One JSON
-    row with both sides."""
+    row with both sides.
+
+    Sweep axes (ISSUE 6): ``DTF_SERVE_REPLICAS`` routes the serve side
+    through an N-replica Router (slots SPLIT across replicas so total
+    capacity is constant — the row measures routing, not extra HBM);
+    ``DTF_SERVE_PREFIX`` stamps that fraction of requests with a shared
+    prompt stem and serves with the prefix page cache ON — the row then
+    also carries a ``serve_off`` side (same arrivals, cache off) so the
+    prefill-work and TTFT deltas are in-row."""
     import dataclasses
 
     import jax
@@ -138,21 +150,24 @@ def child_serve():
 
     from _dtf_watchdog import fence
     from dtf_tpu.models import gpt
-    from dtf_tpu.serve import (DecodeEngine, PoissonLoadGen, Scheduler,
-                               replay)
+    from dtf_tpu.serve import (DecodeEngine, PoissonLoadGen, Router,
+                               Scheduler, replay)
     from dtf_tpu.serve.scheduler import _quantile
 
     tiny = os.environ.get("DTF_DECODE_TINY") == "1"
     if tiny:
         base = gpt.GPTConfig.tiny(dtype=jax.numpy.bfloat16)
-        n_slots, t_p, new_min, new_max = 4, 8, 4, 16
-        rate, n_req, chunk = 200.0, 12, 8
+        n_slots, t_p, new_min, new_max = 4, 48, 4, 16
+        rate, n_req, chunk, page = 200.0, 12, 8, 8
     else:
         base = gpt.GPTConfig.gpt2_small()
         n_slots, t_p, new_min, new_max = 8, 128, 64, 512
-        rate, n_req, chunk = 2.0, 24, 64
+        rate, n_req, chunk, page = 2.0, 24, 64, 32
     rate = float(os.environ.get("DTF_SERVE_RATE", rate))
     n_req = int(os.environ.get("DTF_SERVE_N", n_req))
+    replicas = int(os.environ.get("DTF_SERVE_REPLICAS", "1"))
+    hit_ratio = float(os.environ.get("DTF_SERVE_PREFIX", "0"))
+    page = int(os.environ.get("DTF_SERVE_PAGE", page))
     max_len = t_p + new_max
     cfg = dataclasses.replace(base, decode_len=max_len)
     model = gpt.GPT(cfg, None)
@@ -163,19 +178,74 @@ def child_serve():
                          prompt_max=t_p, new_min=new_min, new_max=new_max,
                          seed=0)
     arrivals = list(gen.arrivals())
+    if hit_ratio > 0:
+        # a seeded fraction of requests shares one prompt stem (system-
+        # prompt traffic shape): ~3/4 of the prompt, page-aligned
+        stem_len = (3 * t_p // 4) // page * page
+        stem = np.random.default_rng(7).integers(
+            0, base.vocab_size, stem_len).tolist()
+        pick = np.random.default_rng(8).random(n_req) < hit_ratio
+        arrivals = [
+            (t, dataclasses.replace(
+                req, prompt=stem + list(req.prompt[stem_len:]))
+             if pick[i] else req)
+            for i, (t, req) in enumerate(arrivals)]
 
-    # ---- serve side: open-loop Poisson against the engine
-    engine = DecodeEngine(base, params, n_slots=n_slots, max_len=max_len,
-                          prefill_chunk=chunk)
-    sched = Scheduler(engine, None, prefill_chunks_per_tick=4)
-    serve_wall = replay(sched, arrivals)
-    goodput = sum(len(sched.poll(r)["tokens"]) for r in range(n_req))
-    st = sched.stats()
-    serve = {"tokens_per_sec": round(goodput / max(serve_wall, 1e-9), 1),
-             "makespan_s": round(serve_wall, 3),
-             "ttft_p50_s": round(st["serve_ttft_p50_s"], 5),
-             "ttft_p99_s": round(st["serve_ttft_p99_s"], 5),
-             "occupancy_mean": round(st["serve_occupancy_mean"], 3)}
+    # slots split across replicas: capacity-constant routing A/B
+    if n_slots % replicas:
+        raise SystemExit(f"n_slots={n_slots} not divisible by "
+                         f"replicas={replicas}")
+
+    def serve_side(prefix_on):
+        pool = (max_len // page) * 2 if prefix_on else 0
+        engines = [DecodeEngine(base, params, n_slots=n_slots // replicas,
+                                max_len=max_len, prefill_chunk=chunk,
+                                kv_page_size=page if prefix_on else 0,
+                                prefix_pages=pool)
+                   for _ in range(replicas)]
+        for e in engines:
+            # warm every program outside the timed window (the static
+            # side's fence(run(...)) move): first-call backend overhead
+            # must not bias the side that happens to run first. The page
+            # programs warm with no-op args (n_valid=0 / empty window);
+            # the warm prefill leaves slot 0 stale-active, which the
+            # first real admission resets by design.
+            e.prefill(0, [0] * t_p, seed=0)
+            e.decode()
+            e.warm_page_programs()
+            for k in e.counters:
+                e.counters[k] = 0
+        if replicas > 1:
+            sched = Router(engines, None, prefill_chunks_per_tick=4)
+        else:
+            sched = Scheduler(engines[0], None, prefill_chunks_per_tick=4)
+        wall = replay(sched, arrivals)
+        goodput = sum(len(sched.poll(r)["tokens"]) for r in range(n_req))
+        st = sched.stats()
+        if replicas > 1:
+            ttft50, ttft99 = st["router_ttft_p50_s"], st["router_ttft_p99_s"]
+            occ = sum(st[f"replica{i}_serve_occupancy_mean"]
+                      for i in range(replicas)) / replicas
+        else:
+            ttft50, ttft99 = st["serve_ttft_p50_s"], st["serve_ttft_p99_s"]
+            occ = st["serve_occupancy_mean"]
+        counters = {}
+        for e in engines:
+            for k, v in e.counters.items():
+                counters[k] = counters.get(k, 0) + v
+        return {"tokens_per_sec": round(goodput / max(wall, 1e-9), 1),
+                "makespan_s": round(wall, 3),
+                "ttft_p50_s": round(ttft50, 5),
+                "ttft_p99_s": round(ttft99, 5),
+                "occupancy_mean": round(occ, 3),
+                "prefill_chunks": counters["prefill_chunks"],
+                "pages_loaded": counters["pages_loaded"],
+                "pages_saved": counters["pages_saved"],
+                "prefix_hit_tokens": counters["prefix_hit_tokens"]}
+
+    # ---- serve side: open-loop Poisson against the engine/router fleet
+    serve = serve_side(prefix_on=hit_ratio > 0)
+    serve_off = serve_side(prefix_on=False) if hit_ratio > 0 else None
 
     # ---- static side: same arrivals, fixed batches, worst-case decode.
     # TTFT for a static server is delivery time: batch end - arrival (a
@@ -208,9 +278,15 @@ def child_serve():
 
     row = {"model": ("gpt_tiny" if tiny else "gpt2_small") + "_serve_ab",
            "backend": jax.default_backend(), "n_slots": n_slots,
+           "replicas": replicas, "prefix_hit_ratio": hit_ratio,
+           "page_size": page if hit_ratio > 0 else 0,
            "prompt": t_p, "new_min": new_min, "new_max": new_max,
            "rate_rps": rate, "n_requests": n_req, "prefill_chunk": chunk,
            "serve": serve, "static": static}
+    if serve_off is not None:
+        # the in-row prefix A/B: same arrivals, page cache off — TTFT p50
+        # must improve and prefill_chunks strictly drop on the ON side
+        row["serve_off"] = serve_off
     print(SENTINEL + json.dumps(row))
 
 
@@ -246,14 +322,22 @@ def main(key="decode"):
         print(json.dumps(err))
         return 1
     if key == "serve":
-        # ONE child runs the continuous-vs-static A/B and emits one row
-        # holding both sides (same seeded arrivals)
+        # each child runs the continuous-vs-static A/B and emits one row
+        # holding both sides (same seeded arrivals); the sweep spans the
+        # ISSUE 6 axes — replica count (capacity-constant routing) and
+        # prefix-hit ratio (rows with hits also carry a serve_off side)
         def on_result(row, job, rows, errors):
             _merge(rows, errors, key="serve")
             print(json.dumps(row if row is not None else errors[-1]))
 
+        serve_jobs = [
+            {},                                       # 1 replica, no stems
+            {"DTF_SERVE_PREFIX": "0.75"},             # prefix cache A/B
+            {"DTF_SERVE_REPLICAS": "2"},              # routing A/B
+            {"DTF_SERVE_REPLICAS": "2", "DTF_SERVE_PREFIX": "0.75"},
+        ]
         rows, errors = run_budgeted_jobs(
-            [{}], child_argv(os.path.abspath(__file__)) + ["--serve"],
+            serve_jobs, child_argv(os.path.abspath(__file__)) + ["--serve"],
             lambda line: (json.loads(line[len(SENTINEL):])
                           if line.startswith(SENTINEL) else None),
             budget=budget, cap_s=CHILD_TIMEOUT_S,

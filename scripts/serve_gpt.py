@@ -49,15 +49,28 @@ flags.DEFINE_integer("attn_global_every", 0, "global-layer cadence "
                      "(manifest wins)")
 flags.DEFINE_string("kv_cache_dtype", "", "'' or 'int8' (serving-side "
                     "choice; halves the cache bytes)")
-flags.DEFINE_integer("n_slots", 8, "concurrent request slots (the KV "
-                     "cache batch dimension)")
+flags.DEFINE_integer("n_slots", 8, "concurrent request slots PER REPLICA "
+                     "(the KV cache batch dimension)")
 flags.DEFINE_integer("max_len", 256, "per-slot token budget "
                      "(prompt + generated)")
 flags.DEFINE_integer("prefill_chunk", 16, "fixed width of the prefill "
                      "program (>= 2); long prompts stream through it")
 flags.DEFINE_integer("prefill_chunks_per_tick", 4, "prefill/decode "
-                     "interleave: at most this many prompt chunks between "
-                     "decode steps (0 = admit greedily)")
+                     "interleave: at most this many prompt chunks (or "
+                     "prefix-page loads) between decode steps (0 = admit "
+                     "greedily)")
+flags.DEFINE_integer("replicas", 1, "DecodeEngine replicas behind the "
+                     "router: one restored param tree, independent KV "
+                     "state each, least-occupancy admission with "
+                     "queue-depth tiebreak (docs/SERVING.md)")
+flags.DEFINE_integer("kv_page_size", 0, "prefix page width in tokens "
+                     "(with --prefix_pages: must divide --max_len)")
+flags.DEFINE_integer("prefix_pages", 0, "prefix KV page-pool size per "
+                     "replica (0 = prefix cache off): shared prompt stems "
+                     "prefill once and fork into slots")
+flags.DEFINE_float("ttft_slo", 0.0, "TTFT objective in seconds (0 = "
+                   "untracked): the JSON line reports per-replica and "
+                   "fleet compliance fractions")
 flags.DEFINE_string("requests", "", "semicolon-separated comma-lists of "
                     "token ids; empty = Poisson load")
 flags.DEFINE_integer("n_new", 32, "max new tokens per explicit request")
@@ -113,18 +126,26 @@ def main(argv):
 
     ckpt_dir = os.path.join(FLAGS.logdir, "ckpt")
     try:
+        # kv dtype + page-size legality checked HERE (against the manifest
+        # architecture and the serving shape), not inside the AOT build
         decode_cfg = dflags.resolve_decode_config(
-            FLAGS, load_model_config(ckpt_dir))
+            FLAGS, load_model_config(ckpt_dir), max_len=FLAGS.max_len,
+            kv_page_size=FLAGS.kv_page_size if FLAGS.prefix_pages else 0)
     except ValueError as e:
         raise app.UsageError(str(e))
     try:
         base = gpt.GPTConfig.by_name(decode_cfg["size"])
     except KeyError as e:
         raise app.UsageError(f"--size: {e.args[0]}")
-    if decode_cfg["kv_cache_dtype"] not in ("", "int8"):
+    if FLAGS.replicas < 1:
+        raise app.UsageError(f"--replicas={FLAGS.replicas} must be >= 1")
+    if FLAGS.kv_page_size and not FLAGS.prefix_pages:
+        # the engine would silently run page-less (page_size gated on the
+        # pool size) — a half-configured cache should fail at flag time
         raise app.UsageError(
-            f"--kv_cache_dtype={decode_cfg['kv_cache_dtype']!r}: "
-            "'' or 'int8'")
+            f"--kv_page_size={FLAGS.kv_page_size} has no effect without "
+            "--prefix_pages > 0 (the prefix page cache stays off); set "
+            "both or neither")
     cfg = dataclasses.replace(base,
                               kv_heads=decode_cfg["kv_heads"] or None,
                               attn_window=decode_cfg["attn_window"],
@@ -143,10 +164,14 @@ def main(argv):
         params = shard_tree(params, mesh, gpt.tp_rules)
 
     try:
-        engine = DecodeEngine(cfg, params, n_slots=FLAGS.n_slots,
-                              max_len=FLAGS.max_len,
-                              prefill_chunk=FLAGS.prefill_chunk, mesh=mesh)
-    except ValueError as e:     # n_slots/max_len/prefill_chunk flag errors
+        engines = [DecodeEngine(cfg, params, n_slots=FLAGS.n_slots,
+                                max_len=FLAGS.max_len,
+                                prefill_chunk=FLAGS.prefill_chunk,
+                                mesh=mesh,
+                                kv_page_size=FLAGS.kv_page_size,
+                                prefix_pages=FLAGS.prefix_pages)
+                   for _ in range(FLAGS.replicas)]
+    except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
         raise app.UsageError(str(e))
     tel = None
     if FLAGS.telemetry:
@@ -158,10 +183,17 @@ def main(argv):
         tel = Telemetry(watchdog=False)
         tel.start()
     writer = MetricWriter(None, also_log=False)
-    sched = Scheduler(
-        engine, writer, log_every=0,
-        prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
-        telemetry=tel)
+    if FLAGS.replicas > 1:
+        from dtf_tpu.serve import Router
+
+        sched = Router(
+            engines, writer, telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
+            prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
+    else:
+        sched = Scheduler(
+            engines[0], writer, log_every=0,
+            prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
+            telemetry=tel, ttft_slo_s=FLAGS.ttft_slo)
 
     eos = FLAGS.eos_id if FLAGS.eos_id >= 0 else None
     t0 = time.perf_counter()
@@ -209,19 +241,26 @@ def main(argv):
             st = sched.poll(rid)
             print(f"{rid}:" + ",".join(str(t) for t in st["tokens"]))
     n_tokens = sum(len(sched.poll(r)["tokens"]) for r in rids)
+    cache_bytes = sum(e.cache_bytes() for e in engines)
     out = {"mode": "requests" if FLAGS.requests else "poisson",
            "backend": jax.default_backend(), "step": step,
+           "replicas": FLAGS.replicas,
            "n_slots": FLAGS.n_slots, "max_len": FLAGS.max_len,
            "prefill_chunk": FLAGS.prefill_chunk,
+           "kv_page_size": FLAGS.kv_page_size if FLAGS.prefix_pages else 0,
+           "prefix_pages": FLAGS.prefix_pages,
            "requests": len(rids), "generated_tokens": n_tokens,
            "wall_s": round(wall, 4),
            "tokens_per_sec": round(n_tokens / max(wall, 1e-9), 1),
-           "cache_mib": round(engine.cache_bytes() / 2 ** 20, 2)}
+           "cache_mib": round(cache_bytes / 2 ** 20, 2)}
     out.update({k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in sched.stats().items()})
     if tel is not None:
         tel.stop()
-        out["trace_counts"] = dict(engine.trace_counts)
+        out["trace_counts"] = [
+            {**e.trace_counts,
+             **{f"page_{k}": v for k, v in e.page_trace_counts.items()}}
+            for e in engines]
         out["compile_events"] = tel.fence.compile_events
         # without this flag, compile_events==0 would be ambiguous between
         # "steady state" and "jax.monitoring unobservable on this jax"

@@ -238,9 +238,11 @@ GOLDEN = runner.golden_path()
 # (ISSUE 3; docs/ZERO.md). gpt_serve rides it so the SERVING decode
 # graph's collectives (dtf_tpu/serve; docs/SERVING.md) are fenced in
 # tier-1 too — decode is a per-token hot path, an accidental cache
-# resharding there is worse than one in a train step.
+# resharding there is worse than one in a train step; gpt_serve_int8
+# fences the quantized-KV variant of the same graph (ISSUE 6) so the
+# dequant-on-read path can't silently grow a collective either.
 FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert", "bert_accum",
-                       "bert_grad_shard", "gpt_serve"]
+                       "bert_grad_shard", "gpt_serve", "gpt_serve_int8"]
 
 
 @pytest.mark.parametrize("name", FAST_BUDGET_CONFIGS)

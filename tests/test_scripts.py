@@ -282,6 +282,35 @@ def test_bench_decode_serve_ab_child_tiny_mode():
     assert 0 < row["serve"]["occupancy_mean"] <= 1
 
 
+def test_bench_decode_serve_prefix_ab_child_tiny_mode():
+    """The prefix-cache A/B (ISSUE 6 acceptance): at hit-ratio > 0 the
+    page cache strictly reduces prefill work (fewer transformer chunks,
+    pages genuinely loaded) and improves TTFT p50 vs the same arrivals
+    with the cache off, on the CPU sim."""
+    env = _env()
+    env.update(DTF_DECODE_TINY="1", DTF_SERVE_RATE="500", DTF_SERVE_N="12",
+               DTF_SERVE_PREFIX="0.75")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "bench_decode.py"),
+         "--child", "--serve"],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-1500:] + proc.stderr[-1500:]
+    import json
+
+    rows = [json.loads(ln[len("BENCH_DECODE_ROW "):])
+            for ln in proc.stdout.splitlines()
+            if ln.startswith("BENCH_DECODE_ROW ")]
+    assert len(rows) == 1
+    on, off = rows[0]["serve"], rows[0]["serve_off"]
+    # prefill-work reduction is deterministic (host counters)
+    assert on["prefill_chunks"] < off["prefill_chunks"], (on, off)
+    assert on["pages_loaded"] > 0 and on["prefix_hit_tokens"] > 0
+    assert off["pages_loaded"] == 0
+    # the latency claim (wall clocks — a small margin absorbs CI noise;
+    # the measured gap is ~25-40% in favor of the cache)
+    assert on["ttft_p50_s"] <= off["ttft_p50_s"] * 1.1, (on, off)
+
+
 def test_serve_launcher_round_trip(tmp_path):
     """train_gpt → serve_gpt: the online half of the flagship loop. The
     launcher restores the params-only item, auto-loads the manifest (no
@@ -324,6 +353,31 @@ def test_serve_launcher_round_trip(tmp_path):
     stats = json.loads([ln for ln in srv_p.splitlines()
                         if ln.startswith("{")][-1])
     assert stats["mode"] == "poisson" and stats["serve_completed"] == 6.0
+
+    # the serving tier: 2 router replicas + the prefix page cache + a TTFT
+    # SLO — same checkpoint, same greedy prompt, same tokens as replica 0
+    # of nothing (offline parity holds through the whole tier)
+    srv_r = _run("serve_gpt.py", f"--logdir={tmp_path}", "--replicas=2",
+                 "--n_slots=2", "--max_len=48", "--prefill_chunk=4",
+                 "--kv_page_size=4", "--prefix_pages=8", "--ttft_slo=30",
+                 "--requests=5,9,2;5,9,2,7,1,3;5,9,2,7,1,4", "--n_new=6",
+                 "--emit_tokens")
+    rstats = json.loads([ln for ln in srv_r.splitlines()
+                         if ln.startswith("{")][-1])
+    assert rstats["router_replicas"] == 2.0
+    assert rstats["router_completed"] == 3.0
+    assert rstats["router_ttft_slo_ok_frac"] == 1.0
+    assert "replica1_serve_occupancy_mean" in rstats
+    row_r = [ln for ln in srv_r.splitlines() if ln.startswith("0:")][0]
+    assert row_r == srv_row          # same greedy continuation of 5,9,2
+
+    # a page size that doesn't tile the cache fails at flag time
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "serve_gpt.py"),
+         f"--logdir={tmp_path}", "--max_len=48", "--kv_page_size=7",
+         "--prefix_pages=8"],
+        env=_env(), capture_output=True, text=True, timeout=120)
+    assert proc.returncode != 0 and "does not divide" in proc.stderr
 
 
 def test_generate_rejects_sampling_flags_at_greedy(tmp_path):

@@ -1,0 +1,232 @@
+"""Prefix page cache (dtf_tpu/serve/pages + engine page programs):
+token identity vs offline generate() with the cache ON (hit, miss,
+eviction churn), refcount release on slot evict, save-admission policy,
+hash-collision safety, and the int8 quantized-KV serving path."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.models import gpt
+from dtf_tpu.serve import (DecodeEngine, PrefixIndex, Request, Scheduler,
+                           ServeClient)
+
+CFG = gpt.GPTConfig.tiny(dtype=jnp.float32)
+MAX_LEN = 48
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = gpt.GPT(dataclasses.replace(CFG, decode_len=MAX_LEN))
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 1), jnp.int32))["params"]
+
+
+def _offline(params, req: dict, cfg=CFG, prefill_chunk=0) -> list[int]:
+    model = gpt.GPT(dataclasses.replace(cfg, decode_len=MAX_LEN))
+    out = gpt.generate(
+        model, params, jnp.asarray([req["prompt"]], jnp.int32),
+        req["max_new"], rng=jax.random.PRNGKey(req.get("seed", 0)),
+        temperature=req.get("temperature", 0.0),
+        top_k=req.get("top_k", 0), top_p=req.get("top_p", 1.0),
+        prefill_chunk=prefill_chunk)
+    return np.asarray(out)[0, len(req["prompt"]):].tolist()
+
+
+def test_prefix_hit_token_identity_greedy_and_sampled(params):
+    """THE acceptance property with the cache ON: hit and miss requests
+    (greedy + seeded sampling) decode token-for-token identically to
+    per-request offline generate(); pages genuinely load on the hit path
+    and the program fences stay pinned."""
+    eng = DecodeEngine(CFG, params, n_slots=3, max_len=MAX_LEN,
+                       prefill_chunk=5, kv_page_size=4, prefix_pages=8,
+                       page_save_after=1)
+    client = ServeClient(eng)
+    rng = np.random.default_rng(3)
+    stem = rng.integers(0, CFG.vocab_size, 12).tolist()
+    reqs = [dict(prompt=stem + rng.integers(0, 128, 5).tolist(),
+                 max_new=8),                                     # miss
+            dict(prompt=stem + rng.integers(0, 128, 3).tolist(),
+                 max_new=6, temperature=0.9, seed=11),           # hit
+            dict(prompt=stem + [7], max_new=5, temperature=0.8,
+                 top_k=3, seed=12),                              # hit
+            dict(prompt=rng.integers(0, 128, 6).tolist(),
+                 max_new=7, seed=13)]                            # no stem
+    rids = [client.submit(**r) for r in reqs]
+    client.drain()
+    for r, rid in zip(reqs, rids):
+        assert client.result(rid) == _offline(params, r), r
+    assert eng.counters["pages_loaded"] > 0
+    assert eng.counters["prefix_hit_tokens"] >= 2 * 12 // 4 * 4
+    assert eng.trace_counts == {"prefill": 1, "decode": 1}
+    assert eng.page_trace_counts == {"save": 1, "load": 1}
+    assert eng._prefix.pinned() == 0       # every admission pin released
+
+
+def test_save_admission_second_sighting(params):
+    """The default save policy: a prefix is cached only on its SECOND
+    sighting (an eager save per unique tail would cost a dispatch and a
+    pool page for KV nobody will hit — pages.py docstring)."""
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=4, kv_page_size=4, prefix_pages=8)
+    client = ServeClient(eng)
+    prompt = list(range(1, 10))                         # two full pages
+    for expect_saved, expect_loaded in [(0, 0), (2, 0), (2, 2)]:
+        assert client.result(client.submit(prompt, max_new=3)) \
+            == _offline(params, dict(prompt=prompt, max_new=3))
+        assert eng.counters["pages_saved"] == expect_saved
+        assert eng.counters["pages_loaded"] == expect_loaded
+
+
+def test_exact_match_verification_survives_hash_collisions():
+    """The token-hash index VERIFIES tokens exactly: with every hash
+    colliding, different prefixes still resolve to their own entries."""
+    idx = PrefixIndex(4, 2, save_after=1, hash_fn=lambda t: 0)
+    a = idx.reserve((1, 2), None)
+    b = idx.reserve((3, 4), None)
+    assert a.page_id != b.page_id
+    ha = idx.acquire((1, 2, 9))
+    hb = idx.acquire((3, 4, 9))
+    assert ha.entries == (a,) and hb.entries == (b,)
+    assert idx.acquire((5, 6, 9)) is None               # verified miss
+    idx.release(ha)
+    idx.release(hb)
+
+
+def test_refcounts_pin_pages_and_lru_eviction():
+    """Pinned chains are never evicted (reserve returns None when every
+    page is held); released LRU pages are; a child entry keeps its parent
+    alive through the chain refs."""
+    idx = PrefixIndex(2, 2, save_after=1)
+    a = idx.reserve((1, 2), None)
+    idx.reserve((1, 2, 3, 4), a)             # child of a: a.refs == 1
+    h = idx.acquire((1, 2, 3, 4, 9))         # pins the deepest entry
+    assert h.n_tokens == 4 and len(h.entries) == 2
+    assert idx.reserve((7, 8), None) is None          # all pinned/parented
+    idx.release(h)
+    assert idx.reserve((7, 8), None) is not None      # LRU leaf evicted
+    assert idx.stats["evictions"] == 1
+    # the parent survived (its child was the eviction candidate)
+    assert idx.longest((1, 2, 99))[0] == 1
+
+
+def test_reserve_never_evicts_the_parent_it_extends():
+    """Pool full, the chain's own childless parent is the only refs==0
+    entry: reserve must SKIP the save (None), not evict the parent — a
+    reused parent page id would leave the new child's chain dangling at
+    KV that now belongs to someone else (wrong tokens on a later hit)."""
+    idx = PrefixIndex(1, 2, save_after=1)
+    a = idx.reserve((1, 2), None)
+    assert idx.reserve((1, 2, 3, 4), a) is None       # a is NOT a victim
+    assert idx.stats["evictions"] == 0
+    h = idx.acquire((1, 2, 9))                        # a still serves hits
+    assert h is not None and h.entries[-1] is a
+    idx.release(h)
+
+
+def test_eviction_churn_token_identity(params):
+    """A pool far smaller than the stem population churns (evictions > 0)
+    while every request still matches offline — a recycled page can never
+    serve stale KV (exact-match verification + refcounted eviction)."""
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=4, kv_page_size=4, prefix_pages=2,
+                       page_save_after=1)
+    client = ServeClient(eng)
+    rng = np.random.default_rng(5)
+    stems = [rng.integers(0, 128, 8).tolist() for _ in range(3)]
+    reqs = []
+    for lap in range(2):
+        for s in stems:                      # each lap revisits each stem
+            reqs.append(dict(prompt=s + rng.integers(0, 128, 2).tolist(),
+                             max_new=4, seed=20 + len(reqs)))
+    rids = [client.submit(**r) for r in reqs]
+    client.drain()
+    for r, rid in zip(reqs, rids):
+        assert client.result(rid) == _offline(params, r), r
+    assert eng.prefix_stats()["evictions"] > 0
+    assert eng._prefix.pinned() == 0
+
+
+def test_int8_pages_token_identity_pinned_seed(params):
+    """Quantized KV + prefix pages: pages carry the int8 values AND their
+    scales bitwise, so with chunk-aligned pages (page_size a multiple of
+    prefill_chunk) a hit decodes exactly like offline chunked generate()
+    at the same boundaries — greedy and pinned-seed sampling. (Misaligned
+    boundaries relax to quantization tolerance — the model-level chunked
+    prefill contract, tested in test_gpt.)"""
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32, kv_heads=2,
+                             kv_cache_dtype="int8")
+    model = gpt.GPT(dataclasses.replace(cfg, decode_len=MAX_LEN))
+    params8 = model.init(jax.random.PRNGKey(1),
+                         jnp.zeros((1, 1), jnp.int32))["params"]
+    eng = DecodeEngine(cfg, params8, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=4, kv_page_size=4, prefix_pages=8,
+                       page_save_after=1)
+    client = ServeClient(eng)
+    rng = np.random.default_rng(6)
+    stem = rng.integers(0, 128, 8).tolist()
+    reqs = [dict(prompt=stem + [5, 6], max_new=6),               # miss
+            dict(prompt=stem + [9], max_new=6),                  # hit
+            dict(prompt=stem + [3, 1], max_new=5, temperature=0.9,
+                 seed=31)]                                       # hit
+    rids = [client.submit(**r) for r in reqs]
+    client.drain()
+    for r, rid in zip(reqs, rids):
+        want = _offline(params8, r, cfg=cfg, prefill_chunk=4)
+        assert client.result(rid) == want, r
+    assert eng.counters["pages_loaded"] > 0
+    # int8 pool leaves ride along: scales present next to int8 pages
+    dtypes = {x.dtype for x in jax.tree.leaves(eng._pages)}
+    assert dtypes == {jnp.dtype(jnp.int8), jnp.dtype(jnp.float32)}
+
+
+def test_interleaved_page_load_does_not_corrupt_running_slots(params):
+    """The spectator contract with pages: a hit admission (page load +
+    tail chunks over several ticks) must leave concurrently decoding
+    slots bit-exact — the load deactivates the slot before any decode
+    runs between admission actions."""
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                       prefill_chunk=3, kv_page_size=3, prefix_pages=6,
+                       page_save_after=1)
+    sched = Scheduler(eng, None, prefill_chunks_per_tick=1)
+    # dirty BOTH slots first: evicted slots keep their stale active flag
+    # and advanced index on device (docs/SERVING.md), so the hit below is
+    # admitted into a slot whose garbage would clobber the loaded pages
+    # if page_load didn't deactivate it
+    warm = dict(prompt=list(range(1, 16)), max_new=2)   # caches the stem
+    warm2 = dict(prompt=[9, 8, 7, 6], max_new=3, temperature=0.5, seed=8)
+    r0 = sched.submit(Request(**warm))
+    r0b = sched.submit(Request(**warm2))
+    sched.run_until_idle()
+    runner = dict(prompt=[11, 22, 33], max_new=14, temperature=0.7, seed=5)
+    r1 = sched.submit(Request(**runner))
+    sched.tick()                                        # runner decoding
+    hit = dict(prompt=list(range(1, 16)) + [40, 41], max_new=8, seed=9)
+    r2 = sched.submit(Request(**hit))                   # load interleaves
+    sched.run_until_idle()
+    assert sched.poll(r0)["tokens"] == _offline(params, warm)
+    assert sched.poll(r0b)["tokens"] == _offline(params, warm2)
+    assert sched.poll(r1)["tokens"] == _offline(params, runner)
+    assert sched.poll(r2)["tokens"] == _offline(params, hit)
+    assert eng.counters["pages_loaded"] > 0
+
+
+def test_page_validation_errors(params):
+    with pytest.raises(ValueError, match="kv_page_size"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=48, prefix_pages=4)
+    with pytest.raises(ValueError, match="does not divide"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=48, kv_page_size=7,
+                     prefix_pages=4)
+    with pytest.raises(ValueError, match="attn_window"):
+        DecodeEngine(gpt.GPTConfig.tiny(dtype=jnp.float32, attn_window=8),
+                     params, n_slots=2, max_len=48, prefill_chunk=4,
+                     kv_page_size=4, prefix_pages=4)
+    eng = DecodeEngine(CFG, params, n_slots=2, max_len=48, prefill_chunk=4,
+                       kv_page_size=4, prefix_pages=4)
+    with pytest.raises(ValueError, match="start"):
+        eng.prefill_chunk_into(0, [1, 2, 3, 4], 0, start=4)
+    with pytest.raises(ValueError, match="save_after"):
+        PrefixIndex(4, 2, save_after=0)
