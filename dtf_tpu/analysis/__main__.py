@@ -9,10 +9,15 @@ scrubbed child exactly like ``__graft_entry__.dryrun_multichip``.
 
     python -m dtf_tpu.analysis                       # all configs, all passes
     python -m dtf_tpu.analysis --configs=bert,gpt    # subset
-    python -m dtf_tpu.analysis --passes=specs,jaxpr  # skip the compile pass
+    python -m dtf_tpu.analysis --passes=specs,jaxpr,collective   # no compile
     python -m dtf_tpu.analysis --write-golden        # regenerate the fence
+    python -m dtf_tpu.analysis --diff                # per-line provenance
+                                                     # delta vs golden (PR
+                                                     # review aid)
 
 Exit status: 0 = no error findings, 1 = findings, 2 = analyzer crashed.
+The non-zero-on-error contract is what makes ``scripts/lint.sh --full``
+usable as a pre-commit gate.
 """
 
 from __future__ import annotations
@@ -64,12 +69,16 @@ def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(prog="python -m dtf_tpu.analysis")
     parser.add_argument("--configs", default="",
                         help="comma-separated registry names (default all)")
-    parser.add_argument("--passes", default="specs,jaxpr,hlo",
+    parser.add_argument("--passes", default="specs,jaxpr,collective,hlo",
                         help="comma-separated passes to run")
     parser.add_argument("--write-golden", action="store_true",
                         help="regenerate STATIC_ANALYSIS.json comms budgets")
     parser.add_argument("--golden", default="",
                         help="override golden path")
+    parser.add_argument("--diff", action="store_true",
+                        help="print the per-source-line collective "
+                             "provenance delta vs the golden (PR review "
+                             "aid; compiles, no findings verdict)")
     args = parser.parse_args(argv)
 
     from dtf_tpu.analysis import configs as cfgs
@@ -85,13 +94,13 @@ def main(argv: list[str] | None = None) -> int:
                                        f"{sorted(cfgs.BY_NAME)}"}))
             return 2
     passes = [p for p in args.passes.split(",") if p]
-    bad = [p for p in passes if p not in ("specs", "jaxpr", "hlo")]
+    bad = [p for p in passes if p not in runner.ALL_PASSES]
     if bad:
         # a typo'd pass must not silently disable the fence (exit 0, ran
         # nothing) — same contract as unknown --configs
         print(json.dumps({"ok": False,
                           "error": f"unknown passes {bad}; valid: "
-                                   f"specs,jaxpr,hlo"}))
+                                   f"{','.join(runner.ALL_PASSES)}"}))
         return 2
     golden_file = args.golden or runner.golden_path()
 
@@ -118,6 +127,27 @@ def main(argv: list[str] | None = None) -> int:
 
         golden = (hlo_pass.load_golden(golden_file)
                   if os.path.exists(golden_file) else {"budgets": {}})
+
+        if args.diff:
+            # review aid, not a verdict: compile each config, print the
+            # per-line provenance delta vs golden as plain lines, keep
+            # the one-JSON-last-line contract with a summary object.
+            from dtf_tpu.analysis import provenance
+
+            diff_counts = {}
+            for c in (cfgs.REGISTRY if not names
+                      else [cfgs.BY_NAME[n] for n in names]):
+                budget = runner.compile_budget(c)
+                want = golden.get("budgets", {}).get(c.name, {})
+                lines = provenance.provenance_delta(
+                    budget.get("provenance"), want.get("provenance"))
+                diff_counts[c.name] = len(lines)
+                for line in lines:
+                    print(f"{c.name}: {line}")
+            print(json.dumps({"ok": True, "mode": "diff",
+                              "changed_lines": diff_counts}))
+            return 0
+
         budgets: dict = {}
         findings = runner.analyze(names or None, passes, golden=golden,
                                   budgets_out=budgets)

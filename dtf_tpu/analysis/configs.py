@@ -267,6 +267,56 @@ def _gpt_serve_step(mesh):
     return StepView(step, abs_params, abs_state)
 
 
+def _gpt_eval_step(mesh):
+    """The launcher's EVAL program (``lm_eval_hook`` →
+    ``tr.make_eval_step`` over ``gpt.make_eval``) — an AOT program that
+    runs every ``--eval_every`` window on the same mesh as training but
+    was never fenced: a spec regression visible only in the eval graph
+    (e.g. GSPMD all-gathering the head table for the full-logits CE)
+    would surface as a mysterious eval-time stall, not a tier-1 failure."""
+    from dtf_tpu.models import gpt
+
+    cfg = _gpt_cfg(True)
+    model, init_fn = gpt.make_init(cfg, mesh, seq_len=32)
+    tx = optax.adamw(3e-4, weight_decay=0.1)
+    state, shardings = tr.abstract_train_state(
+        init_fn, tx, _rng(), mesh, param_rules=gpt.tp_rules)
+    batch = _abstract_batch("gpt", 8, seq_len=32, vocab_size=128)
+    batch_sh = batch_shardings_for(batch, mesh, P("data", "seq"))
+    step = tr.make_eval_step(gpt.make_eval(model), mesh, shardings,
+                             batch_shardings=batch_sh)
+    return StepView(step, state, batch)
+
+
+def _gpt_prefill_step(mesh):
+    """The serving engine's PREFILL program (``serve.engine``
+    ``prefill_into_slot``) at the ``gpt_serve`` mesh — fences the
+    admission path's collectives, including the known sharded-prefill
+    dynamic-slice resharding PR 4 documented as un-fenced (docs/SERVING.md):
+    growth there now fails tier-1 instead of quietly eating TTFT."""
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve.engine import prefill_step_view
+
+    step, abs_params, ops = prefill_step_view(
+        gpt.GPTConfig.tiny(), n_slots=8, max_len=64, prefill_chunk=8,
+        mesh=mesh)
+    return StepView(step, abs_params, ops)
+
+
+def _gpt_pages_step(mesh):
+    """The PR 6 page programs (``page_load`` ∘ ``page_save`` — one
+    admission tick of the prefix page cache) as one fenced step: the
+    batched pool gather/scatter must stay a fixed set of collectives
+    however the pool is laid out."""
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve.engine import page_step_view
+
+    step, bundle, ops = page_step_view(
+        gpt.GPTConfig.tiny(), n_slots=8, max_len=64, kv_page_size=16,
+        n_pages=4, mesh=mesh)
+    return StepView(step, bundle, ops)
+
+
 def _gpt_serve_int8_step(mesh):
     """``gpt_serve`` with ``kv_cache_dtype="int8"`` — the quantized-KV
     decode graph (int8 K/V + f32 per-position scales in the cache,
@@ -340,7 +390,10 @@ def _gpt_pipe_tp_step(mesh):
 
 
 #: the registry: five BASELINE workloads + the GPT flagship + pipelined
-#: variants + the MoE expert-parallel path (all-to-all coverage).
+#: variants + the MoE expert-parallel path (all-to-all coverage) + the
+#: whole AOT-program inventory beyond train steps — serving decode
+#: (bf16/int8), serving prefill, the page-cache tick, and the eval step
+#: (ISSUE 7: the fence covers the fleet, not one program shape).
 REGISTRY: tuple[AnalysisConfig, ...] = (
     AnalysisConfig("mnist", MeshConfig(data=8), _mnist_spec, _mnist_step),
     AnalysisConfig("resnet_cifar", MeshConfig(data=8),
@@ -377,6 +430,22 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    _gpt_spec(), _gpt_serve_int8_step,
                    # the quantized-KV serving decode graph (same mesh,
                    # same spec view — params don't quantize).
+                   allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_eval", MeshConfig(data=2, seq=2, model=2),
+                   _gpt_spec(), _gpt_eval_step,
+                   # the launcher's eval program at the training mesh —
+                   # whole-inventory fence: every AOT program rides the
+                   # golden, not just train steps (ISSUE 7).
+                   allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_prefill", MeshConfig(data=4, model=2),
+                   _gpt_spec(), _gpt_prefill_step,
+                   # the serving ADMISSION path (prefill_into_slot) at
+                   # the gpt_serve mesh — the engine's other AOT program.
+                   allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_pages", MeshConfig(data=4, model=2),
+                   _gpt_spec(), _gpt_pages_step,
+                   # the prefix-page-cache load/save programs (PR 6) —
+                   # one admission tick, fenced like any other program.
                    allow_dead=(r"w_(in|out)$",)),
     AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),
                    _gpt_pipe_spec, _gpt_pipe_step("gpipe"),

@@ -1,0 +1,114 @@
+"""Source-attributed comms provenance — who introduced each collective.
+
+XLA op metadata (``source_file``/``source_line``) survives lowering into
+the optimized HLO, so every collective the comms-budget fence counts can
+be attributed to the Python line that introduced it. That turns a
+``collective-count-drift`` finding from "all-reduce 126→127" into
+"all-reduce +1 at dtf_tpu/core/train.py:396", and gives PR review a
+per-line delta view (``python -m dtf_tpu.analysis --diff``).
+
+Provenance is recorded in the golden next to each budget but is NOT
+itself fenced: line numbers shift on every unrelated edit to a traced
+file, and a fence over them would page on comment changes. It exists to
+*attribute* count/byte drift the opcode fence already caught, and to
+print review diffs — staleness only ever makes an annotation slightly
+off, never a finding wrong. (``--write-golden`` refreshes it wholesale;
+expect provenance churn in the JSON diff whenever traced sources moved.)
+
+Paths are normalized repo-relative (anchored on the last ``dtf_tpu`` /
+``tests`` / ``scripts`` path segment; anything outside the repo — jax,
+flax internals — keeps its basename) so goldens compare across machines.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+#: repo path anchors: everything from the LAST occurrence of one of these
+#: segments on is the stable cross-machine identity of a source file.
+_ANCHORS = ("dtf_tpu", "tests", "scripts")
+
+_META_RE = re.compile(
+    r'source_file="(?P<file>[^"]+)"\s+source_line=(?P<line>\d+)')
+
+
+def _rel(path: str) -> str:
+    parts = path.replace("\\", "/").split("/")
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] in _ANCHORS:
+            return "/".join(parts[i:])
+    return parts[-1]
+
+
+def collective_provenance(hlo_text: str) -> dict:
+    """``{op: {"file:line": {count, bytes}}}`` from optimized HLO text.
+
+    Reuses the hlo pass's opcode matcher line-by-line (HLO prints one op
+    per line) and pairs each collective with the ``metadata={...}`` on
+    its own line; collectives with no source metadata (rare: fusion
+    roots synthesized by passes) land under ``"<unattributed>"``.
+    """
+    from dtf_tpu.analysis import hlo as hlo_pass
+
+    prov: dict[str, dict[str, dict]] = {}
+    for line in hlo_text.splitlines():
+        m = hlo_pass._COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        op = m.group("op")
+        nbytes, _ = hlo_pass._shape_bytes(m.group("type"))
+        meta = _META_RE.search(line)
+        loc = (f"{_rel(meta.group('file'))}:{meta.group('line')}"
+               if meta else "<unattributed>")
+        slot = prov.setdefault(op, {}).setdefault(
+            loc, {"count": 0, "bytes": 0})
+        slot["count"] += 1
+        slot["bytes"] += nbytes
+    return prov
+
+
+def provenance_delta(got: Mapping[str, Any] | None,
+                     want: Mapping[str, Any] | None) -> list[str]:
+    """Human-readable per-line delta, most-moved first; [] when clean."""
+    got, want = got or {}, want or {}
+    rows = []
+    for op in sorted(set(got) | set(want)):
+        g_op, w_op = got.get(op, {}), want.get(op, {})
+        for loc in sorted(set(g_op) | set(w_op)):
+            g = g_op.get(loc, {"count": 0, "bytes": 0})
+            w = w_op.get(loc, {"count": 0, "bytes": 0})
+            dc, db = g["count"] - w["count"], g["bytes"] - w["bytes"]
+            if dc or db:
+                rows.append((abs(dc), abs(db),
+                             f"{op} {dc:+d} ({db:+,} B) at {loc} "
+                             f"[{w['count']}→{g['count']}]"))
+    rows.sort(reverse=True)
+    return [r[2] for r in rows]
+
+
+def attribute_drift(op: str, got_prov: Mapping[str, Any] | None,
+                    want_prov: Mapping[str, Any] | None,
+                    *, limit: int = 3) -> str:
+    """Short suffix for a drift finding: the top moved lines of ``op``.
+
+    Empty string when EITHER side carries no provenance at all (a
+    pre-provenance golden, a metadata-stripped backend): diffing real
+    call sites against an empty record would list every existing line as
+    "drift" and misdirect the reader — better no attribution than wrong
+    attribution. An op merely absent on one side (0 → N call sites) is
+    attributed normally.
+    """
+    if got_prov is None or want_prov is None:
+        return ""
+    got = got_prov.get(op)
+    want = want_prov.get(op)
+    if got is None and want is None:
+        return ""
+    lines = provenance_delta({op: got or {}}, {op: want or {}})
+    if not lines:
+        return ""
+    shown = "; ".join(lines[:limit])
+    more = f" (+{len(lines) - limit} more lines)" if len(lines) > limit \
+        else ""
+    return f" — {shown}{more}"

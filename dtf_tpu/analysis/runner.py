@@ -5,6 +5,7 @@ from __future__ import annotations
 import os
 from typing import Sequence
 
+from dtf_tpu.analysis import collective as collective_pass
 from dtf_tpu.analysis import configs as cfgs
 from dtf_tpu.analysis import hlo as hlo_pass
 from dtf_tpu.analysis import jaxpr as jaxpr_pass
@@ -12,6 +13,9 @@ from dtf_tpu.analysis import specs as specs_pass
 from dtf_tpu.analysis.findings import Finding
 
 GOLDEN_BASENAME = "STATIC_ANALYSIS.json"
+
+#: every pass the runner knows, in execution order.
+ALL_PASSES = ("specs", "jaxpr", "collective", "hlo")
 
 
 def golden_path() -> str:
@@ -44,6 +48,18 @@ def run_jaxpr(config: cfgs.AnalysisConfig, view=None) -> list[Finding]:
     return jaxpr_pass.lint_jaxpr(closed, config=config.name)
 
 
+def run_collective(config: cfgs.AnalysisConfig, view=None) -> list[Finding]:
+    """Collective soundness over the step's shard_map bodies (no compile).
+
+    Per-config only — the config-independent mirrored-ring fence is
+    :func:`dtf_tpu.analysis.collective.ring_soundness`, run once per
+    :func:`analyze` invocation rather than once per config.
+    """
+    view = view or config.step_view(config.mesh())
+    closed = jaxpr_pass.trace_step(view.step, view.state, view.batch)
+    return collective_pass.lint_collectives(closed, config=config.name)
+
+
 def compile_budget(config: cfgs.AnalysisConfig, view=None) -> dict:
     """AOT-compile the tiny train step and extract its comms budget."""
     view = view or config.step_view(config.mesh())
@@ -64,7 +80,7 @@ def run_hlo(config: cfgs.AnalysisConfig, golden: dict,
 
 
 def analyze(names: Sequence[str] | None = None,
-            passes: Sequence[str] = ("specs", "jaxpr", "hlo"),
+            passes: Sequence[str] = ALL_PASSES,
             golden: dict | None = None,
             budgets_out: dict | None = None) -> list[Finding]:
     """Run the requested passes over the requested configs.
@@ -80,15 +96,27 @@ def analyze(names: Sequence[str] | None = None,
         golden = (hlo_pass.load_golden(path) if os.path.exists(path)
                   else {"budgets": {}})
     findings: list[Finding] = []
+    if "collective" in passes:
+        # config-independent: the mirrored-ring fence over every
+        # registered custom_vjp ring pair (ops/collective_matmul).
+        findings += collective_pass.ring_soundness()
     for config in selected:
         if "specs" in passes:
             findings += run_specs(config)
         # the step view (mesh + full train-step construction) is the
-        # expensive part — build it once and share across jaxpr/hlo
+        # expensive part — build it once and share across all trace/
+        # compile passes; jaxpr + collective also share the one trace
         view = (config.step_view(config.mesh())
-                if {"jaxpr", "hlo"} & set(passes) else None)
-        if "jaxpr" in passes:
-            findings += run_jaxpr(config, view)
+                if {"jaxpr", "collective", "hlo"} & set(passes) else None)
+        if {"jaxpr", "collective"} & set(passes):
+            closed = jaxpr_pass.trace_step(view.step, view.state,
+                                           view.batch)
+            if "jaxpr" in passes:
+                findings += jaxpr_pass.lint_jaxpr(closed,
+                                                  config=config.name)
+            if "collective" in passes:
+                findings += collective_pass.lint_collectives(
+                    closed, config=config.name)
         if "hlo" in passes:
             budget = compile_budget(config, view)
             if budgets_out is not None:

@@ -15,6 +15,12 @@ imports executed):
   model code must route TP collectives through ``dtf_tpu.core.comms``
   (one choke point: the comms-budget fence and the ``--tp_overlap``
   collective-matmul dispatch both live behind it),
+- raw ``jax.lax.ppermute`` perm lists outside ``core/comms.py`` /
+  ``ops/collective_matmul.py`` — a perm at a ppermute call site must be
+  a name bound from the named builders ``ring_perm``/``shift_perm``
+  (``core/comms.py``), the construction the collective soundness pass
+  (``analysis/collective.py``) introspects; a hand-typed pair list with
+  one transposed entry compiles clean and trains silently wrong,
 - blocking device readbacks (``int(...)``/``float(...)``/``.item()``) in
   the iteration loop of ``dtf_tpu/loop.py``'s ``Trainer.fit`` — the hot
   path is SYNC-FREE (PR 3: a per-step readback serializes dispatch
@@ -157,11 +163,110 @@ def lint_file(path: str) -> list[str]:
                     f"dtf_tpu.core.comms (the comms-budget fence and "
                     f"--tp_overlap dispatch choke point)")
 
+    # ---- raw ppermute perm lists (must come from the named builders) ----
+    base = os.path.basename(path)
+    blessed_perm_module = (
+        ("dtf_tpu" in dirs or (bool(dirs) and dirs[-1] in ("core", "ops")))
+        and ((base == "comms.py" and (not dirs or dirs[-1] == "core"))
+             or (base == "collective_matmul.py"
+                 and (not dirs or dirs[-1] == "ops"))))
+    if not blessed_perm_module:
+        problems += _raw_ppermute_perms(tree, path, noqa)
+
     # ---- blocking readbacks in the trainer hot path (loop.py fit) ----
     if os.path.basename(path) == "loop.py" and (
             "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
         problems += _hotpath_readbacks(tree, path, noqa, src)
 
+    return problems
+
+
+#: the sanctioned perm constructors (core/comms.py) — the introspection
+#: surface of the collective soundness pass.
+_PERM_BUILDERS = ("ring_perm", "shift_perm")
+
+
+def _raw_ppermute_perms(tree, path: str, noqa: set) -> list:
+    """``jax.lax.ppermute`` calls whose ``perm`` is not a name bound from
+    ``ring_perm``/``shift_perm`` — outside the two ring modules, rings
+    must come from the named helpers the soundness pass can introspect
+    (the PR 2 fence idiom, applied to perm construction).
+
+    A name counts as blessed only when EVERY assignment to it in the file
+    is a builder call — a second function hand-typing a pair list into a
+    name some other scope blessed (``perm`` is the idiomatic name
+    everywhere) must not ride the first function's blessing.
+    """
+    def _is_builder(value) -> bool:
+        if not isinstance(value, ast.Call):
+            return False
+        fn = value.func
+        fn_name = (fn.id if isinstance(fn, ast.Name)
+                   else fn.attr if isinstance(fn, ast.Attribute) else None)
+        return fn_name in _PERM_BUILDERS
+
+    #: in-place mutators that de-bless a builder-built list.
+    _MUTATORS = ("append", "extend", "insert", "remove", "pop", "sort",
+                 "reverse", "clear")
+
+    blessed: set[str] = set()
+    tainted: set[str] = set()
+    for node in ast.walk(tree):
+        targets = ()
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = (node.target,), node.value
+        elif isinstance(node, ast.AugAssign):
+            # perm += [...] hand-edits a blessed list — taint it
+            targets, value = (node.target,), None
+        for tgt in targets:
+            names = ([tgt] if isinstance(tgt, ast.Name)
+                     else [e for e in ast.walk(tgt)
+                           if isinstance(e, ast.Name)])
+            for nm in names:
+                (blessed if _is_builder(value) else tainted).add(nm.id)
+        # perm.append((0, 2)) mutates in place — taint too
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS
+                and isinstance(node.func.value, ast.Name)):
+            tainted.add(node.func.value.id)
+    blessed -= tainted
+
+    problems = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.lineno not in noqa):
+            continue
+        # every spelling: jax.lax.ppermute / lax.ppermute / a bare
+        # `ppermute` from `from jax.lax import ppermute` — leaving one
+        # spelling unfenced leaves the hole open
+        if isinstance(node.func, ast.Attribute):
+            if node.func.attr != "ppermute":
+                continue
+        elif not (isinstance(node.func, ast.Name)
+                  and node.func.id == "ppermute"):
+            continue
+        perm = None
+        if len(node.args) >= 3:
+            perm = node.args[2]
+        else:
+            perm = next((kw.value for kw in node.keywords
+                         if kw.arg == "perm"), None)
+        if (isinstance(perm, ast.Name) and perm.id in blessed):
+            continue
+        if isinstance(perm, ast.Call):
+            fn = perm.func
+            fn_name = (fn.id if isinstance(fn, ast.Name)
+                       else fn.attr if isinstance(fn, ast.Attribute)
+                       else None)
+            if fn_name in _PERM_BUILDERS:
+                continue
+        problems.append(
+            f"{path}:{node.lineno}: raw perm at jax.lax.ppermute call — "
+            f"build it with core.comms.ring_perm/shift_perm (the named "
+            f"helpers the collective soundness pass introspects); a "
+            f"hand-typed pair list dodges the ring fence")
     return problems
 
 

@@ -65,6 +65,41 @@ def axis_size(axis: str) -> int:
     return jax.lax.axis_size(axis)
 
 
+def ring_perm(n: int, *, shift: int = 1) -> list[tuple[int, int]]:
+    """THE named cyclic ring schedule: device ``i`` sends to ``(i+shift) % n``.
+
+    Every ``ppermute`` ring in the tree (collective matmul, ring/zigzag
+    attention, the pipeline's interleaved wraparound) must build its perm
+    here or via :func:`shift_perm` — one construction point the collective
+    soundness pass (``analysis/collective.py``) can introspect, and the
+    srclint fence holds call sites outside ``core/comms.py`` /
+    ``ops/collective_matmul.py`` to it (a hand-typed perm with a transposed
+    pair compiles fine and trains silently wrong).
+    """
+    if n < 1:
+        raise ValueError(f"ring_perm: axis size {n} must be >= 1")
+    if shift % n == 0 and n > 1:
+        raise ValueError(f"ring_perm: shift {shift} is a no-op on n={n}")
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def shift_perm(n: int, *, shift: int = 1) -> list[tuple[int, int]]:
+    """Non-cyclic neighbor shift: ``i → i+shift``, edges fall off (devices
+    that receive nothing get zeros — the halo-exchange / pipeline-edge
+    contract, deliberately NOT a permutation of the whole axis).
+
+    Same introspection story as :func:`ring_perm` — the named helpers are
+    the only sanctioned perm constructions outside the two ring modules.
+    """
+    if n < 1:
+        raise ValueError(f"shift_perm: axis size {n} must be >= 1")
+    if not -n < shift < n:
+        raise ValueError(f"shift_perm: shift {shift} out of range for n={n}")
+    if shift >= 0:
+        return [(i, i + shift) for i in range(n - shift)]
+    return [(i, i + shift) for i in range(-shift, n)]
+
+
 def ring_pass(x: PyTree, axis: str, *, shift: int = 1) -> PyTree:
     """Pass each shard to its ring neighbor along ``axis`` (ppermute).
 
@@ -72,7 +107,7 @@ def ring_pass(x: PyTree, axis: str, *, shift: int = 1) -> PyTree:
     rides a single ICI hop per step.
     """
     n = jax.lax.axis_size(axis)
-    perm = [(i, (i + shift) % n) for i in range(n)]
+    perm = ring_perm(n, shift=shift)
     return jax.tree.map(lambda t: jax.lax.ppermute(t, axis, perm), x)
 
 

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.core.comms import ring_perm
+
 
 def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = False,
@@ -109,7 +111,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     scale = sm_scale if sm_scale is not None else d ** -0.5
     if kv_mask is None:
         kv_mask = (q[:, 0, :, 0] * 0 + 1).astype(bool)        # [B,Tl], varying
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
 
     if h % k.shape[1]:
         # validate before the group-1 shortcut: 3 q heads over 2 kv heads
@@ -226,7 +228,7 @@ def zigzag_ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError(f"zigzag shard length {t_l} must be even")
     c = t_l // 2
     scale = sm_scale if sm_scale is not None else d ** -0.5
-    perm = [(i, (i + 1) % n) for i in range(n)]
+    perm = ring_perm(n)
 
     lo_pos = idx * c + jnp.arange(c)
     hi_pos = (2 * n - 1 - idx) * c + jnp.arange(c)
@@ -354,7 +356,7 @@ def halo_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     scale = sm_scale if sm_scale is not None else d ** -0.5
 
     if halo > 0:
-        perm = [(i, (i + 1) % n) for i in range(n)]
+        perm = ring_perm(n)
         k_halo = jax.lax.ppermute(k[:, :, t - halo:], axis_name, perm)
         v_halo = jax.lax.ppermute(v[:, :, t - halo:], axis_name, perm)
         kk = jnp.concatenate([k_halo, k], axis=2)       # [b,h,halo+t,d]

@@ -50,15 +50,22 @@ the ``not slow`` tier.
 from __future__ import annotations
 
 import functools
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.core.comms import ring_perm
+
 
 def _ring_perm(n: int):
-    """Send to the next ring neighbor: device i → i+1 (one ICI hop)."""
-    return [(i, (i + 1) % n) for i in range(n)]
+    """Send to the next ring neighbor: device i → i+1 (one ICI hop).
+
+    Delegates to the named builder in ``core/comms.py`` — the one perm
+    construction point the collective soundness pass introspects.
+    """
+    return ring_perm(n)
 
 
 def _rows(full: jax.Array, src: jax.Array, t: int) -> jax.Array:
@@ -282,3 +289,49 @@ def matmul_rs_sharded(y: jax.Array, w: jax.Array, mesh: Mesh, *,
         functools.partial(matmul_rs, axis), mesh=mesh,
         in_specs=(P("data", "seq", axis), P(axis, None)),
         out_specs=_token_spec(axis), check_vma=False)(y, w)
+
+
+# ---------------------------------------------------------------------------
+# Introspection surface for the collective soundness pass.
+# ---------------------------------------------------------------------------
+
+class RingOp(NamedTuple):
+    """One custom_vjp ring op as the analyzer sees it: the forward impl,
+    the backward impl, and tiny abstract per-shard arguments for each —
+    enough to trace both sides at a given axis size and hold the rings to
+    the mirrored-ring invariant (``analysis/collective.py``).
+
+    ``fwd`` is called ``fwd(axis_name, *fwd_args(n))``; ``bwd`` is called
+    ``bwd(axis_name, *bwd_args(n))`` where the first bwd arg is the saved
+    residual tuple and the second the output cotangent.
+    """
+
+    name: str
+    fwd: object
+    bwd: object
+    fwd_args: object      # n -> tuple of ShapeDtypeStructs (per-shard)
+    bwd_args: object      # n -> (residuals, cotangent) ShapeDtypeStructs
+
+
+def ring_inventory() -> tuple[RingOp, ...]:
+    """Every shipped collective-matmul ring pair, declared for the
+    soundness pass. A new ring op MUST register here: the pass verifies
+    (a) every perm either side binds is a true ring permutation and (b)
+    the backward rides the forward's ring or its inverse — the mirrored-
+    ring invariant overlap-under-grad depends on (module docstring).
+    Numeric parity stays pinned by tests/test_collective_matmul.py; this
+    hook is what lets a *static* pass catch a transposed perm pair or a
+    backward that silently fell off the ring."""
+    t, d, f = 2, 4, 4
+    sds = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+
+    return (
+        RingOp(
+            "ag_matmul", _ag_matmul_impl, _ag_matmul_bwd,
+            lambda n: (sds(t, d), sds(d, f)),
+            lambda n: ((sds(t, d), sds(d, f)), sds(n * t, f))),
+        RingOp(
+            "matmul_rs", _matmul_rs_impl, _matmul_rs_bwd,
+            lambda n: (sds(n * t, f), sds(f, d)),
+            lambda n: ((sds(n * t, f), sds(f, d)), sds(t, d))),
+    )

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dtf_tpu.core.comms import ring_perm, shift_perm
 from dtf_tpu.core.mesh import AXIS_PIPE
 
 PyTree = Any
@@ -115,7 +116,7 @@ def pipeline_spmd(
                 xs = jax.lax.pcast(xs, (axis_name,), to="varying")
             p = jax.tree.map(lambda t: t[0], params)
             idx = jax.lax.axis_index(axis_name)
-            shift = [(i, i + 1) for i in range(n_stages - 1)]
+            shift = shift_perm(n_stages)
 
             def step(carry, t):
                 act, out = carry
@@ -287,8 +288,8 @@ def pipeline_1f1b_grads(
         def body(p_first, p_stack, p_last, mb):
             p_stage = jax.tree.map(lambda t: t[0], p_stack)
             idx = jax.lax.axis_index(axis_name)
-            down = [(i, i + 1) for i in range(S - 1)]
-            up = [(i + 1, i) for i in range(S - 1)]
+            down = shift_perm(S)
+            up = shift_perm(S, shift=-1)
             mb0 = jax.tree.map(lambda t: t[0], mb)
             x_sd = jax.eval_shape(first_fn, p_first, mb0)
             act0 = jnp.zeros(x_sd.shape, x_sd.dtype)
@@ -491,7 +492,7 @@ def pipeline_interleaved(
                 xs = jax.lax.pcast(xs, (axis_name,), to="varying")
             p_local = jax.tree.map(lambda t: t, params)   # [V, ...] shard
             idx = jax.lax.axis_index(axis_name)
-            ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+            ring = ring_perm(n_stages)
 
             def step(carry, t):
                 act, out = carry

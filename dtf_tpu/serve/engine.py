@@ -665,18 +665,121 @@ def decode_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
     registry's ``gpt_serve`` config lowers so the comms-budget fence
     covers the serving decode graph exactly as ``DecodeEngine`` compiles
     it (same model, same state layout, same shardings)."""
-    from dtf_tpu.core.sharding import tree_shardings
-
     dec_cfg = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True)
     model = gpt.GPT(dec_cfg, mesh)
     step = jax.jit(_build_decode_fn(model))
+    abs_state = _state_struct(dec_cfg, n_slots, mesh)
+    return step, _abs_params(dec_cfg, mesh), abs_state
+
+
+def _abs_params(cfg: gpt.GPTConfig, mesh: Optional[Mesh]) -> PyTree:
+    """Abstract TP-sharded param tree — identical across the decode /
+    prefill / page model variants (architecture config, not cache mode)."""
+    from dtf_tpu.core.sharding import tree_shardings
+
+    model = gpt.GPT(dataclasses.replace(cfg, slot_decode=True), mesh)
     shapes = jax.eval_shape(lambda: model.init(
-        jax.random.PRNGKey(0), jnp.zeros((n_slots, 1), jnp.int32)))
+        jax.random.PRNGKey(0), jnp.zeros((1, 1), jnp.int32)))
     abs_params = shapes["params"]
     if mesh is not None:
         abs_params = jax.tree.map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
                                                sharding=sh),
             abs_params, tree_shardings(abs_params, mesh, gpt.tp_rules))
-    abs_state = _state_struct(dec_cfg, n_slots, mesh)
-    return step, abs_params, abs_state
+    return abs_params
+
+
+def prefill_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
+                      prefill_chunk: int = 8, mesh: Optional[Mesh] = None):
+    """The engine's prefill program as an analyzable step:
+    ``(jitted_fn, abstract_params, abstract_operand_bundle)`` — the same
+    ``prefill_into_slot`` body ``DecodeEngine`` AOT-compiles (slot slice →
+    chunked-prefill model → slot write-back → first-token sample), with
+    the scalar operands bundled into one pytree so the analysis runner's
+    two-argument step shape fits. The comms-budget fence this enables
+    covers the known sharded-prefill resharding cost (engine docstring:
+    GSPMD respells the traced-index slot slice as a resharding of the
+    touched cache leaves) — previously documented, now pinned."""
+    base = dataclasses.replace(cfg, decode_len=max_len, slot_decode=False,
+                               chunked_prefill=False)
+    model = gpt.GPT(dataclasses.replace(base, chunked_prefill=True), mesh)
+    prefill_fn = _build_prefill_fn(model)
+
+    def step(params, ops):
+        return prefill_fn(
+            params, ops["state"], ops["slot"], ops["start"], ops["chunk"],
+            ops["n_valid"], ops["reset"], ops["is_last"], ops["temp"],
+            ops["top_k"], ops["top_p"], ops["eos"], ops["pad"], ops["key"])
+
+    abs_state = _state_struct(
+        dataclasses.replace(base, slot_decode=True), n_slots, mesh)
+    jit_kw = {}
+    if mesh is not None:
+        # the engine pins the output state to the input layout (its AOT
+        # executables reject resharded state) — the fenced graph must be
+        # the SAME pinned program, not GSPMD's free choice
+        rep = NamedSharding(mesh, P())
+        jit_kw["out_shardings"] = (
+            jax.tree.map(lambda s: s.sharding, abs_state),
+            {"token": rep, "done": rep})
+    s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    ops = {
+        "state": abs_state,
+        "slot": s_i32, "start": s_i32,
+        "chunk": jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32),
+        "n_valid": s_i32,
+        "reset": jax.ShapeDtypeStruct((), jnp.bool_),
+        "is_last": jax.ShapeDtypeStruct((), jnp.bool_),
+        "temp": jax.ShapeDtypeStruct((), jnp.float32),
+        "top_k": s_i32,
+        "top_p": jax.ShapeDtypeStruct((), jnp.float32),
+        "eos": s_i32, "pad": s_i32,
+        "key": jax.ShapeDtypeStruct((2,), jnp.uint32),
+    }
+    return jax.jit(step, **jit_kw), _abs_params(base, mesh), ops
+
+
+def page_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
+                   kv_page_size: int, n_pages: int,
+                   mesh: Optional[Mesh] = None):
+    """The page programs as one analyzable step: ``page_load`` of a
+    pinned chain followed by ``page_save`` of the new pages — an
+    admission tick, exactly the two extra AOT programs a
+    ``prefix_pages > 0`` engine compiles (their own trace fence,
+    ``page_trace_counts``). Returned as ``(jitted_fn, state_bundle,
+    operand_bundle)``; the fence pins the batched gather/scatter
+    collectives so a pool-layout change that makes GSPMD move whole
+    cache leaves per admission fails tier-1 first."""
+    from dtf_tpu.serve import pages as pages_lib
+
+    if max_len % kv_page_size:
+        raise ValueError(
+            f"kv_page_size={kv_page_size} does not divide "
+            f"max_len={max_len} (same rule as DecodeEngine)")
+    dec_cfg = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True)
+    state_abs = _state_struct(dec_cfg, n_slots, mesh)
+    pool_abs = pages_lib.pool_abstract(state_abs["cache"], n_pages,
+                                       kv_page_size, mesh)
+    load_fn = _build_page_load_fn()
+    save_fn = _build_page_save_fn(n_pages)
+
+    def step(bundle, ops):
+        st = load_fn(bundle["state"], bundle["pool"], ops["slot"],
+                     ops["ids"], ops["n_valid"])
+        pool = save_fn(st, bundle["pool"], ops["slot"], ops["ids"],
+                       ops["lo"], ops["hi"])
+        return {"state": st, "pool": pool}
+
+    jit_kw = {}
+    if mesh is not None:
+        # same pin as the engine's page programs (load_kw/save_kw): the
+        # fence must compile the pinned layouts, not GSPMD's free choice
+        jit_kw["out_shardings"] = {
+            "state": jax.tree.map(lambda s: s.sharding, state_abs),
+            "pool": jax.tree.map(lambda s: s.sharding, pool_abs)}
+    s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    ops = {"slot": s_i32,
+           "ids": jax.ShapeDtypeStruct((max_len // kv_page_size,),
+                                       jnp.int32),
+           "n_valid": s_i32, "lo": s_i32, "hi": s_i32}
+    return jax.jit(step, **jit_kw), {"state": state_abs, "pool": pool_abs}, ops

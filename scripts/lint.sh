@@ -6,14 +6,23 @@
 #
 #   scripts/lint.sh             # lint dtf_tpu/ + scripts/ + tests/
 #   scripts/lint.sh --analyze   # + the static analyzer's cheap passes
-#                               #   (specs,jaxpr — no compiles)
+#                               #   (specs,jaxpr,collective — no compiles)
+#   scripts/lint.sh --full      # + the WHOLE analyzer (all passes incl.
+#                               #   the AOT comms-budget fence) — the
+#                               #   pre-commit gate: exits non-zero on any
+#                               #   error finding. Probe-free: the
+#                               #   analysis CLI re-execs itself into the
+#                               #   8-device CPU sim (_dtf_env.cpu_sim_env)
+#                               #   so a TPU-pointed shell cannot hang it.
 #   scripts/lint.sh PATH ...    # lint specific paths
 set -u
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 cd "$ROOT"
 
 ANALYZE=0
+FULL=0
 if [ "${1:-}" = "--analyze" ]; then ANALYZE=1; shift; fi
+if [ "${1:-}" = "--full" ]; then FULL=1; shift; fi
 
 TARGETS=("$@")
 if [ ${#TARGETS[@]} -eq 0 ]; then
@@ -62,8 +71,16 @@ rc=$?
 [ $rc -ne 0 ] && exit $rc
 
 if [ "$ANALYZE" = "1" ]; then
-  echo "lint: dtf_tpu.analysis (specs,jaxpr)"
-  python -m dtf_tpu.analysis --passes=specs,jaxpr
+  echo "lint: dtf_tpu.analysis (specs,jaxpr,collective)"
+  python -m dtf_tpu.analysis --passes=specs,jaxpr,collective
+  rc=$?
+fi
+
+if [ "$FULL" = "1" ]; then
+  echo "lint: dtf_tpu.analysis (all passes incl. comms-budget fence)"
+  # the CLI exits 1 on any error finding and 2 on a crash — srclint above
+  # plus this is the whole static gate (docs/ANALYSIS.md)
+  python -m dtf_tpu.analysis
   rc=$?
 fi
 

@@ -241,8 +241,13 @@ GOLDEN = runner.golden_path()
 # resharding there is worse than one in a train step; gpt_serve_int8
 # fences the quantized-KV variant of the same graph (ISSUE 6) so the
 # dequant-on-read path can't silently grow a collective either.
+# gpt_eval/gpt_prefill/gpt_pages complete the whole-inventory fence
+# (ISSUE 7): every AOT program in the system — eval step, serve
+# admission, page cache tick — fails tier-1 on drift, not just the
+# train steps and the decode view.
 FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert", "bert_accum",
-                       "bert_grad_shard", "gpt_serve", "gpt_serve_int8"]
+                       "bert_grad_shard", "gpt_serve", "gpt_serve_int8",
+                       "gpt_eval", "gpt_prefill", "gpt_pages"]
 
 
 @pytest.mark.parametrize("name", FAST_BUDGET_CONFIGS)
@@ -256,9 +261,13 @@ def test_comms_budget_matches_golden(name):
                                 config=name)
     assert not findings, findings
     # every fast-tier graph moves data over the mesh: the DP gradient
-    # mean in the train steps, the TP row-parallel projections in the
-    # gpt_serve decode step — all spelled all-reduce
-    assert budget["all-reduce"]["count"] > 0
+    # mean in the train steps and the TP row-parallel projections are
+    # all-reduces; the page programs' pool gather/scatter over data
+    # shards is all-gathers — a budget of zero collectives would mean
+    # the fence is staring at the wrong graph
+    assert budget["total"]["count"] > 0
+    if name != "gpt_pages":
+        assert budget["all-reduce"]["count"] > 0
 
 
 @pytest.mark.slow
@@ -269,6 +278,226 @@ def test_comms_budget_matches_golden_slow(name):
     budget = runner.compile_budget(cfgs.BY_NAME[name])
     assert not hlo.check_budget(budget, golden["budgets"][name],
                                 config=name)
+
+
+# ------------------------------------------------- collective soundness
+
+MESH42_REAL = None   # built lazily (needs the 8-device sim)
+
+
+def _mesh42():
+    global MESH42_REAL
+    if MESH42_REAL is None:
+        MESH42_REAL = jax.sharding.Mesh(
+            np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    return MESH42_REAL
+
+
+def _collective_checks(fn, *args):
+    from dtf_tpu.analysis import collective as col
+
+    closed = jax.make_jaxpr(jax.jit(fn))(*args)
+    return {f.check for f in col.lint_collectives(closed, config="fix")}
+
+
+def test_collective_flags_mutated_perm():
+    """ISSUE 7 seeded defect 1: a duplicated destination in a ppermute
+    perm (nondeterministic overwrite) — the transposed-pair class the
+    parity tests only catch if a test exercises that exact ring."""
+    mesh = _mesh42()
+
+    def f(x):
+        def body(y):
+            return jax.lax.ppermute(              # noqa: seeded defect
+                y, "data", [(0, 1), (1, 2), (2, 3), (3, 1)])
+        return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                             out_specs=P("data"))(x)
+
+    assert _collective_checks(f, jnp.ones(8)) == {"ppermute-not-permutation"}
+
+
+def test_collective_flags_dropped_psum():
+    """ISSUE 7 seeded defect 3: contracting a sharded dim and escaping
+    claiming replication, with no reduction — each shard returns its
+    local partial sum; compiles clean, trains silently wrong."""
+    mesh = _mesh42()
+
+    def dropped(x, w):
+        def body(xs, ws):
+            return jnp.einsum("ik,kj->ij", xs, ws)   # k sharded: partial!
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(None, "data"), P("data", None)),
+                             out_specs=P(), check_vma=False)(x, w)
+
+    assert _collective_checks(
+        dropped, jnp.ones((4, 8)), jnp.ones((8, 4))) == {
+            "unreduced-partial-escape"}
+
+    def kept(x, w):
+        def body(xs, ws):
+            return jax.lax.psum(jnp.einsum("ik,kj->ij", xs, ws), "data")
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(P(None, "data"), P("data", None)),
+                             out_specs=P(), check_vma=False)(x, w)
+
+    assert not _collective_checks(kept, jnp.ones((4, 8)), jnp.ones((8, 4)))
+
+
+def test_collective_partial_shift_is_legal():
+    """A halo-style edge shift (unique pairs, no wraparound) is NOT a
+    defect — receivers of nothing get zeros by ppermute's contract."""
+    from dtf_tpu.core.comms import shift_perm
+
+    mesh = _mesh42()
+
+    def f(x):
+        def body(y):
+            # distinct name: this module also hand-types seeded-defect
+            # perms, and the srclint blessing is file-global (a name with
+            # any non-builder assignment anywhere is tainted)
+            edge = shift_perm(4)
+            return jax.lax.ppermute(y, "data", edge)
+        return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                             out_specs=P("data"))(x)
+
+    assert not _collective_checks(f, jnp.ones(8))
+
+
+def test_collective_flags_unknown_axis():
+    """A collective bound over an axis the enclosing mesh doesn't carry
+    (a vmap axis crossing into shard_map) resolves against whatever is
+    in scope — never what the rulebook meant."""
+    mesh = _mesh42()
+
+    def f(x):
+        def body(y):
+            return jax.vmap(lambda v: jax.lax.psum(v, "v"),
+                            axis_name="v")(y)
+        return jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                             out_specs=P("data"), check_vma=False)(x)
+
+    assert "unknown-collective-axis" in _collective_checks(
+        f, jnp.ones((8, 4)))
+
+
+def test_ring_soundness_flags_non_mirrored_bwd():
+    """ISSUE 7 seeded defect 2: a backward ring that is neither the
+    forward ring nor its inverse (here stride-2 vs stride-1), and a
+    backward with no ring at all (silent blocking-collective fallback) —
+    both break the mirrored-ring invariant overlap-under-grad needs."""
+    from dtf_tpu.analysis import collective as col
+    from dtf_tpu.ops.collective_matmul import RingOp, _ag_matmul_impl
+
+    sds = lambda *s: jax.ShapeDtypeStruct(s, jnp.float32)  # noqa: E731
+
+    def stride2_bwd(axis_name, res, dy):
+        x, w = res
+        n = jax.lax.axis_size(axis_name)
+        perm = [(i, (i + 2) % n) for i in range(n)]
+        moved = jax.lax.ppermute(dy, axis_name, perm)  # noqa: seeded defect
+        return moved[:x.shape[0]] * 0 + x, w
+
+    def no_ring_bwd(axis_name, res, dy):
+        return res
+
+    mk = lambda name, bwd: RingOp(                         # noqa: E731
+        name, _ag_matmul_impl, bwd,
+        lambda n: (sds(2, 4), sds(4, 4)),
+        lambda n: ((sds(2, 4), sds(4, 4)), sds(n * 2, 4)))
+    assert {f.check for f in col.ring_soundness(
+        [mk("stride2", stride2_bwd)], axis_sizes=(4,))} == {
+            "ring-not-mirrored"}
+    assert {f.check for f in col.ring_soundness(
+        [mk("noring", no_ring_bwd)], axis_sizes=(4,))} == {
+            "ring-not-mirrored"}
+
+
+def test_ring_soundness_shipping_rings_clean():
+    """The registered collective-matmul ring pairs pass their own fence."""
+    from dtf_tpu.analysis import collective as col
+
+    assert not col.ring_soundness()
+
+
+@pytest.mark.parametrize("name", ["mnist", "bert", "gpt_overlap",
+                                  "gpt_serve", "gpt_prefill"])
+def test_shipping_config_collectives_clean(name):
+    """The clean tree stays finding-free under the soundness pass —
+    including the ring-heaviest config (gpt_overlap: collective matmul
+    under grad) and the serving programs."""
+    assert not errors(runner.run_collective(cfgs.BY_NAME[name]))
+
+
+# ------------------------------------------------- provenance + dtypes
+
+_F8_HLO = """
+ENTRY main {
+  ag = f8e4m3fn[16,8]{1,0} all-gather(x), dimensions={0}, metadata={op_name="q" source_file="/w/repo/dtf_tpu/ops/q.py" source_line=12}
+  ar = s4[64]{0} all-reduce(y), metadata={op_name="k" source_file="/w/repo/dtf_tpu/core/k.py" source_line=7}
+  ROOT r = f32[] constant(0)
+}
+"""
+
+_UNKNOWN_DTYPE_HLO = """
+ENTRY main {
+  ag = f6e3m2[16]{0} all-gather(x), dimensions={0}
+  ROOT r = f32[] constant(0)
+}
+"""
+
+
+def test_f8_and_s4_collectives_count_bytes():
+    """ISSUE 7 satellite: fp8 and packed 4-bit collective results must
+    count real bytes — 0-byte fp8 rows are a hole in the byte fence."""
+    stats = hlo.collective_stats(_F8_HLO)
+    assert stats["all-gather"] == {"count": 1, "bytes": 16 * 8}   # 1 B/elem
+    assert stats["all-reduce"] == {"count": 1, "bytes": 64 // 2}  # 4 bits
+    assert "unknown_dtypes" not in stats
+
+
+def test_unknown_collective_dtype_is_a_finding():
+    """An unrecognized non-token dtype must fail closed, not count 0 B."""
+    stats = hlo.collective_stats(_UNKNOWN_DTYPE_HLO)
+    assert stats["unknown_dtypes"] == ["f6e3m2"]
+    findings = hlo.check_budget(stats, copy.deepcopy(stats), config="fix")
+    assert {f.check for f in findings} == {"unknown-dtype"}
+
+
+def test_provenance_parses_source_lines():
+    from dtf_tpu.analysis import provenance
+
+    prov = provenance.collective_provenance(_F8_HLO)
+    assert prov["all-gather"] == {
+        "dtf_tpu/ops/q.py:12": {"count": 1, "bytes": 128}}
+    assert prov["all-reduce"] == {
+        "dtf_tpu/core/k.py:7": {"count": 1, "bytes": 32}}
+
+
+def test_drift_finding_names_the_offending_line():
+    """The whole point of provenance: a count drift names file:line, not
+    just 'all-reduce 1→2'."""
+    budget = hlo.collective_stats(_F8_HLO)
+    from dtf_tpu.analysis import provenance
+
+    budget["provenance"] = provenance.collective_provenance(_F8_HLO)
+    golden = copy.deepcopy(budget)
+    golden["all-reduce"]["count"] += 1
+    golden["provenance"]["all-reduce"]["dtf_tpu/core/k.py:7"]["count"] += 1
+    findings = hlo.check_budget(budget, golden, config="fix")
+    drift = [f for f in findings if f.check == "collective-count-drift"]
+    assert drift and "dtf_tpu/core/k.py:7" in drift[0].detail, findings
+
+
+def test_provenance_delta_lines():
+    from dtf_tpu.analysis import provenance
+
+    got = {"all-reduce": {"a.py:1": {"count": 2, "bytes": 64}}}
+    want = {"all-reduce": {"a.py:1": {"count": 1, "bytes": 32}},
+            "all-gather": {"b.py:9": {"count": 1, "bytes": 8}}}
+    lines = provenance.provenance_delta(got, want)
+    assert any("a.py:1" in ln and "+1" in ln for ln in lines)
+    assert any("b.py:9" in ln and "-1" in ln for ln in lines)
+    assert not provenance.provenance_delta(want, copy.deepcopy(want))
 
 
 # ------------------------------------------------------------ CLI + lint
@@ -351,6 +580,79 @@ def test_srclint_fences_direct_collectives_in_models(tmp_path):
             probs += [p for p in srclint.lint_file(
                 os.path.join(models_dir, f)) if "core.comms" in p]
     assert not probs, probs
+
+
+def test_srclint_fences_raw_ppermute_perms(tmp_path):
+    """ISSUE 7 satellite: a ppermute perm outside core/comms.py /
+    ops/collective_matmul.py must be a name bound from
+    ring_perm/shift_perm — the named builders the soundness pass
+    introspects. Raw pair lists (inline or hand-assembled) are findings;
+    the two ring modules themselves are exempt (they ARE the builders)."""
+    from dtf_tpu.analysis import srclint
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n\n"
+        "def f(x, n):\n"
+        "    perm = [(i, (i + 1) % n) for i in range(n)]\n"
+        "    y = jax.lax.ppermute(x, 'seq', perm)\n"
+        "    return jax.lax.ppermute(y, 'seq', [(0, 1), (1, 0)])\n")
+    probs = srclint.lint_file(str(bad))
+    assert sum("ring_perm" in p for p in probs) == 2, probs
+
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        "import jax\n"
+        "from dtf_tpu.core.comms import ring_perm, shift_perm\n\n"
+        "def f(x, n):\n"
+        "    perm = ring_perm(n)\n"
+        "    x = jax.lax.ppermute(x, 'seq', perm)\n"
+        "    x = jax.lax.ppermute(x, 'seq', shift_perm(n))\n"
+        "    halo = shift_perm(n, shift=-1)\n"
+        "    return jax.lax.ppermute(x, 'seq', halo)\n")
+    assert not srclint.lint_file(str(ok))
+
+    # the two ring modules themselves stay exempt, and the shipping tree
+    # (attention/pipeline now routed through the builders) is clean
+    root_files = [os.path.join(ROOT, "dtf_tpu", "ops", "attention.py"),
+                  os.path.join(ROOT, "dtf_tpu", "parallel", "pipeline.py"),
+                  os.path.join(ROOT, "dtf_tpu", "core", "comms.py"),
+                  os.path.join(ROOT, "dtf_tpu", "ops",
+                               "collective_matmul.py")]
+    for f in root_files:
+        assert not [p for p in srclint.lint_file(f) if "ring_perm" in p], f
+
+
+def test_cli_diff_mode_smoke():
+    """--diff prints per-line provenance deltas (0 on a clean tree) and
+    keeps the one-JSON-last-line contract."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis", "--configs=mnist",
+         "--diff"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=600)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert out["mode"] == "diff" and out["changed_lines"] == {"mnist": 0}
+
+
+def test_cli_exits_nonzero_on_error_finding(tmp_path):
+    """ISSUE 7 satellite: the CLI is a usable pre-commit gate — any
+    error finding (here: a doctored golden) must exit 1, not 0."""
+    golden = hlo.load_golden(GOLDEN)
+    doctored = {"_meta": golden["_meta"],
+                "budgets": {"mnist": copy.deepcopy(
+                    golden["budgets"]["mnist"])}}
+    doctored["budgets"]["mnist"]["all-reduce"]["count"] += 1
+    gpath = tmp_path / "golden.json"
+    gpath.write_text(json.dumps(doctored))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.analysis", "--configs=mnist",
+         "--passes=hlo", f"--golden={gpath}"],
+        env=_env(), cwd=ROOT, capture_output=True, text=True, timeout=600)
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert proc.returncode == 1 and out["ok"] is False
+    assert any(d["check"] == "collective-count-drift"
+               for d in out["details"])
 
 
 def test_cli_reports_comms_delta():
