@@ -1,0 +1,44 @@
+"""Bounded-history JSON artifact plumbing shared by the bench parents.
+
+bench_telemetry.py / bench_profile.py merge rows into committed
+``{"runs": [...]}`` artifacts (TELEMETRY.json, DEVICE_PROFILE.json) and
+fence new rows against the newest committed same-config baseline. Their
+parents must NEVER import anything under dtf_tpu (importing the package
+pulls jax, which can hang against a dead axon tunnel — the
+_dtf_watchdog contract), so the shared helpers live here at the repo
+root, importable with no dependencies at all.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def load_runs(path: str) -> list:
+    """The artifact's runs list; [] for a missing/malformed file (the
+    artifact reader must not be able to fail the bench reporting on it)."""
+    try:
+        with open(path) as f:
+            prev = json.load(f)
+        if isinstance(prev, dict) and isinstance(prev.get("runs"), list):
+            return prev["runs"]
+    except (OSError, ValueError):
+        pass
+    return []
+
+
+def merge_runs(path: str, entry: dict, meta: dict,
+               keep_runs: int = 20) -> dict:
+    """Append one row (newest LAST, history bounded) and rewrite the
+    artifact — telemetry.run.merge_artifact's semantics, jax-free."""
+    data = {"runs": load_runs(path)}
+    data["runs"] = (data["runs"] + [{**entry, **meta}])[-keep_runs:]
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+    return data
+
+
+def same_config(a: dict, b: dict, keys) -> bool:
+    """Rows are fence-comparable only when every identity key matches —
+    rows measured under different shapes/models/backends never are."""
+    return all(a.get(k) == b.get(k) for k in keys)

@@ -21,5 +21,16 @@ a TPU device mesh:
 
 __version__ = "0.1.0"
 
-from dtf_tpu import _jax_compat  # noqa: F401  (backfills jax.shard_map etc.)
-from dtf_tpu.core.mesh import MeshConfig, make_mesh, AXIS_DATA, AXIS_SEQ, AXIS_MODEL
+try:
+    import jax as _jax  # noqa: F401
+except ImportError:
+    # Backend-less machine: the training/serving stack is unusable, but
+    # dtf_tpu.telemetry's XPlane parser and report CLI must still import
+    # (traces are captured on a chip and analyzed wherever convenient —
+    # the srclint lazy-import fence keeps those modules jax/tf-free, and
+    # tests/test_analysis.py proves the no-backend import path works).
+    HAVE_JAX = False
+else:
+    HAVE_JAX = True
+    from dtf_tpu import _jax_compat  # noqa: F401  (backfills jax.shard_map etc.)
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh, AXIS_DATA, AXIS_SEQ, AXIS_MODEL  # noqa: F401,E501

@@ -55,6 +55,17 @@ def _install() -> None:
 
         jax.sharding.AxisType = AxisType
 
+    if not hasattr(jax.distributed, "is_initialized"):
+        # newer-jax API core/dist.py guards re-initialization with; on
+        # this jax the fact lives on the private global coordination
+        # state (client is None until initialize() connects it)
+        def _dist_is_initialized():
+            from jax._src import distributed as _dist
+
+            return _dist.global_state.client is not None
+
+        jax.distributed.is_initialized = _dist_is_initialized
+
     if not hasattr(jax, "shard_map"):
         from jax.experimental.shard_map import shard_map as _shard_map
 

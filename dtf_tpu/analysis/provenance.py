@@ -32,6 +32,11 @@ _ANCHORS = ("dtf_tpu", "tests", "scripts")
 _META_RE = re.compile(
     r'source_file="(?P<file>[^"]+)"\s+source_line=(?P<line>\d+)')
 
+#: instruction name on the LHS of an HLO line: `%all-reduce.2 = ...` —
+#: the SAME name the profiler stamps into XPlane op events as ``hlo_op``,
+#: which is what makes device time joinable to source lines.
+_INSTR_RE = re.compile(r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=")
+
 
 def _rel(path: str) -> str:
     parts = path.replace("\\", "/").split("/")
@@ -39,6 +44,40 @@ def _rel(path: str) -> str:
         if parts[i] in _ANCHORS:
             return "/".join(parts[i:])
     return parts[-1]
+
+
+def instruction_sites(hlo_text: str, *, ops=None) -> dict:
+    """``{instruction_name: {"op": opcode, "loc": "file:line"}}`` for every
+    collective instruction in optimized HLO text.
+
+    The shared source-anchoring helper: the comms-budget golden records
+    per-``file:line`` aggregates (:func:`collective_provenance`), while the
+    XPlane device-profile parser (:mod:`dtf_tpu.telemetry.profile`) needs
+    the PER-INSTRUCTION map — a profiled ``all-reduce.2`` event joins to
+    its Python call site through the instruction name, so device seconds
+    can be attributed to the line that issued the collective. ``ops``
+    restricts the opcode set (default: the fence's COLLECTIVE_OPS).
+    Instructions without source metadata map to ``"<unattributed>"``.
+    """
+    from dtf_tpu.analysis import hlo as hlo_pass
+
+    sites: dict[str, dict] = {}
+    for line in hlo_text.splitlines():
+        m = hlo_pass._COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        op = m.group("op")
+        if ops is not None and op not in ops:
+            continue
+        nm = _INSTR_RE.match(line)
+        if nm is None:
+            continue
+        meta = _META_RE.search(line)
+        loc = (f"{_rel(meta.group('file'))}:{meta.group('line')}"
+               if meta else "<unattributed>")
+        nbytes, _ = hlo_pass._shape_bytes(m.group("type"))
+        sites[nm.group("name")] = {"op": op, "loc": loc, "bytes": nbytes}
+    return sites
 
 
 def collective_provenance(hlo_text: str) -> dict:
@@ -66,6 +105,22 @@ def collective_provenance(hlo_text: str) -> dict:
         slot["count"] += 1
         slot["bytes"] += nbytes
     return prov
+
+
+def profile_site_map(hlo_texts) -> dict:
+    """Flatten ``instruction_sites`` over several programs' HLO texts into
+    one ``{hlo_op_name: {"op", "loc", "bytes"}}`` join table for the
+    device-profile parser. ``hlo_texts``: iterable of optimized HLO
+    strings (or a single string). Later programs win name collisions —
+    instruction names are unique within a module, and profiled runs window
+    one program at a time, so collisions only matter across programs that
+    never share a trace."""
+    if isinstance(hlo_texts, str):
+        hlo_texts = (hlo_texts,)
+    out: dict[str, dict] = {}
+    for text in hlo_texts:
+        out.update(instruction_sites(text))
+    return out
 
 
 def provenance_delta(got: Mapping[str, Any] | None,

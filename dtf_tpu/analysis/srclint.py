@@ -27,7 +27,14 @@ imports executed):
   against compute and defeats the prefetch double-buffer); designated
   backpressure points carry a ``# blocking-ok: <why>`` marker. This
   protects the invariant statically; tests/test_telemetry.py proves it
-  dynamically with the counter-instrumented fit.
+  dynamically with the counter-instrumented fit,
+- module-level ``jax`` / ``tensorflow`` imports in ``dtf_tpu/telemetry/``
+  — the telemetry package (the XPlane parser and report CLI especially)
+  must import without ANY backend present: reports are generated on
+  machines with no chip from traces captured on one, and a jax import in
+  a live axon env can hang outright (the loop.py lazy-import idiom,
+  enforced). Backend-touching helpers import lazily inside functions; a
+  deliberate exception carries ``# noqa``.
 
 Usage: ``python -m dtf_tpu.analysis.srclint PATH [PATH ...]`` — prints one
 finding per line, exits 1 if any.
@@ -178,6 +185,59 @@ def lint_file(path: str) -> list[str]:
             "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
         problems += _hotpath_readbacks(tree, path, noqa, src)
 
+    # ---- backend imports fenced out of the telemetry package ----
+    in_telemetry = ("telemetry" in dirs
+                    if "dtf_tpu" in dirs
+                    else bool(dirs) and dirs[-1] == "telemetry")
+    if in_telemetry:
+        problems += _backend_imports(tree, path, noqa)
+
+    return problems
+
+
+#: module roots whose import pulls a backend (or its proto stack) into
+#: the process — fenced at telemetry module level, lazy-only inside.
+_BACKEND_ROOTS = ("jax", "jaxlib", "tensorflow")
+
+
+def _backend_imports(tree, path: str, noqa: set) -> list:
+    """Import-time backend imports in ``dtf_tpu/telemetry/`` — the
+    package must stay importable (and its parser runnable) in a process
+    with no jax/tensorflow at all, and a module-level jax import in a
+    live axon env can hang before any code runs (CLAUDE.md). Lazy
+    imports inside functions are the sanctioned spelling; anything that
+    executes at module import time is fenced, including imports wrapped
+    in try/if or sitting in a class body (they still run on import)."""
+    def module_time_nodes(body):
+        # every statement that executes when the module is imported:
+        # descend into try/if/with/class bodies, NOT into functions
+        # (a def's body runs at call time — that's the lazy spelling)
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            yield node
+            for attr in ("body", "orelse", "finalbody"):
+                yield from module_time_nodes(getattr(node, attr, []) or [])
+            for h in getattr(node, "handlers", []) or []:
+                yield from module_time_nodes(h.body)
+
+    problems = []
+    for node in module_time_nodes(tree.body):
+        roots = []
+        if isinstance(node, ast.Import):
+            roots = [a.name.split(".")[0] for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                and node.module:
+            roots = [node.module.split(".")[0]]
+        for root in roots:
+            if root in _BACKEND_ROOTS and node.lineno not in noqa:
+                problems.append(
+                    f"{path}:{node.lineno}: module-level '{root}' import "
+                    f"in dtf_tpu/telemetry/ — the telemetry package must "
+                    f"import without a backend (reports parse traces on "
+                    f"chipless machines; an axon-env jax import can "
+                    f"hang); import it lazily inside the function that "
+                    f"needs it")
     return problems
 
 

@@ -100,7 +100,7 @@ def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,
                     place_batch=place_batch)
 
 
-def profiler_hooks(FLAGS):
+def profiler_hooks(FLAGS, telemetry=None, flops_per_step=None):
     """[ProfilerHook] from the profiler flags, or [].
 
     ``--profile_steps`` schedules the classic fixed window; independently,
@@ -108,6 +108,12 @@ def profiler_hooks(FLAGS):
     or ``touch <logdir>/profile.trigger`` — so a misbehaving run can be
     profiled without restarting with a pre-chosen step window. One hook
     serves both modes (dtf_tpu/hooks.py ProfilerHook docstring).
+
+    Every closed window is parsed into ``<logdir>/profile/
+    device_profile.json`` (per-category device-time buckets, comm/compute
+    overlap) by the hook's analyze path; ``telemetry`` +
+    ``flops_per_step`` additionally put the device-MFU cross-check in the
+    RunReport (docs/OBSERVABILITY.md, device-time attribution).
     """
     import os
     import signal as _signal
@@ -126,7 +132,8 @@ def profiler_hooks(FLAGS):
         trigger_file=(os.path.join(FLAGS.logdir, "profile.trigger")
                       if on_demand else None),
         trigger_signal=(getattr(_signal, "SIGUSR1", None)
-                        if on_demand else None))]
+                        if on_demand else None),
+        telemetry=telemetry, flops_per_step=flops_per_step)]
 
 
 def telemetry_from_flags(FLAGS, info):

@@ -10,6 +10,8 @@ nothing on the device timeline unless they block on results.
 
 from __future__ import annotations
 
+import json
+import os
 import signal
 import time
 from typing import Any, Mapping
@@ -296,13 +298,33 @@ class ProfilerHook(Hook):
     process-local. Construct + ``begin()`` in the main thread when using
     ``trigger_signal`` (CPython's ``signal.signal`` rule); previous
     handlers are restored at ``end()``.
+
+    **Device-time attribution** (``analyze=True``, the default): when a
+    window closes, the hook hands its trace dir to the XPlane parser
+    (:mod:`dtf_tpu.telemetry.profile`) and writes the per-category
+    device-time buckets / overlap efficiency / per-collective provenance
+    report to ``<logdir>/device_profile.json`` (also kept as
+    ``self.last_profile`` and fed to ``telemetry`` for the RunReport).
+    ``hlo_text_fn`` — optional ``() -> str | list[str]`` returning the
+    profiled program's OPTIMIZED HLO text, called lazily at parse time —
+    enables the ``file:line`` provenance join. The stock launchers do
+    NOT pass it (lowering a twin step just for provenance costs a full
+    compile); their windows bucket without attribution, and the join
+    runs where the HLO is already in hand — ``scripts/bench_profile.py``
+    (its own compiled program) or ``python -m dtf_tpu.telemetry report
+    --hlo=...`` over the same trace dir. The parse runs on the host
+    after the window closed: it adds zero work to traced steps and
+    degrades to a reason dict when the proto bindings or per-op events
+    are absent.
     """
 
     telemetry_bucket = "profile"
 
     def __init__(self, logdir: str, start_step: int | None = 10,
                  num_steps: int = 5, *, trigger_file: str | None = None,
-                 trigger_signal: int | None = None, check_every: int = 16):
+                 trigger_signal: int | None = None, check_every: int = 16,
+                 analyze: bool = True, hlo_text_fn=None, telemetry=None,
+                 flops_per_step=None):
         self.logdir = logdir
         self.start = start_step
         self.num_steps = num_steps
@@ -311,6 +333,11 @@ class ProfilerHook(Hook):
         self.trigger_file = trigger_file
         self.trigger_signal = trigger_signal
         self.check_every = max(1, check_every)
+        self.analyze = analyze
+        self.hlo_text_fn = hlo_text_fn
+        self.telemetry = telemetry
+        self.flops_per_step = flops_per_step
+        self.last_profile: dict | None = None
         self._active = False
         self._signaled = False
         self._sched_done = start_step is None
@@ -334,8 +361,6 @@ class ProfilerHook(Hook):
             self._signaled = False
             return True
         if self.trigger_file and step % self.check_every == 0:
-            import os
-
             if os.path.exists(self.trigger_file):
                 try:
                     os.unlink(self.trigger_file)   # consume: one touch,
@@ -369,11 +394,45 @@ class ProfilerHook(Hook):
         if self._active and self.stop is not None and step >= self.stop:
             jax.profiler.stop_trace()
             self._active = False
+            self._analyze_window()
+
+    def _analyze_window(self) -> None:
+        """Parse the just-closed window's XPlane dump (see class docstring).
+        Never raises: a parse failure becomes a ``degraded`` reason in the
+        report — profiling must not be able to crash the training run."""
+        if not self.analyze:
+            return
+        try:
+            from dtf_tpu.telemetry import profile as profile_mod
+
+            site_map = None
+            if self.hlo_text_fn is not None:
+                from dtf_tpu.analysis.provenance import profile_site_map
+
+                site_map = profile_site_map(self.hlo_text_fn())
+            kw = {}
+            if self.flops_per_step and self.telemetry is not None:
+                kw = {"model_flops_per_step": self.flops_per_step,
+                      "peak_flops": self.telemetry.peak_flops,
+                      "n_devices": self.telemetry.n_devices}
+            report = profile_mod.parse_logdir(
+                self.logdir, site_map=site_map, **kw)
+            path = os.path.join(self.logdir, "device_profile.json")
+            os.makedirs(self.logdir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(report, f, indent=1)
+        except Exception as e:  # noqa: BLE001 — see docstring
+            report = {"degraded": f"profile parse failed: "
+                                  f"{type(e).__name__}: {e}"}
+        self.last_profile = report
+        if self.telemetry is not None:
+            self.telemetry.note_device_profile(report)
 
     def end(self, state):
         if self._active:
             jax.profiler.stop_trace()
             self._active = False
+            self._analyze_window()
         if self._prev_handler is not None:
             signal.signal(self.trigger_signal, self._prev_handler)
             self._prev_handler = None

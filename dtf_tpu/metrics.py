@@ -13,7 +13,10 @@ from __future__ import annotations
 import logging
 from typing import Mapping
 
-import jax
+# jax is imported lazily inside the two call sites that need it:
+# dtf_tpu.telemetry's span/flight modules import `quantile` from here, and
+# the telemetry package must import on machines with no backend at all
+# (the srclint lazy-import fence + tests/test_analysis.py no-backend test).
 
 log = logging.getLogger("dtf_tpu")
 
@@ -34,6 +37,8 @@ class MetricWriter:
     """Scalar writer: stdout logging always, TensorBoard when logdir given."""
 
     def __init__(self, logdir: str | None = None, *, also_log: bool = True):
+        import jax
+
         self._writers = []
         self._is_chief = jax.process_index() == 0
         if not self._is_chief:
@@ -73,6 +78,8 @@ def jit_log(fmt: str, **values) -> None:
     Unlike the reference's ``LoggingTensorHook`` (which ran a separate fetch
     through the session), this rides the compiled program asynchronously.
     """
+
+    import jax
 
     def _cb(**kw):
         log.info(fmt.format(**{k: float(v) for k, v in kw.items()}))

@@ -26,12 +26,13 @@ from millions of users") actually asks for. Five layers:
 docs/SERVING.md walks the architecture and the fixed-shape rules.
 """
 
-from dtf_tpu.serve.client import PoissonLoadGen, ServeClient, replay
+from dtf_tpu.serve.client import (Heartbeat, PoissonLoadGen, ServeClient,
+                                  replay)
 from dtf_tpu.serve.engine import DecodeEngine, decode_step_view
 from dtf_tpu.serve.pages import PrefixIndex
 from dtf_tpu.serve.router import Router
 from dtf_tpu.serve.scheduler import Request, Scheduler
 
-__all__ = ["DecodeEngine", "PoissonLoadGen", "PrefixIndex", "Request",
-           "Router", "Scheduler", "ServeClient", "decode_step_view",
-           "replay"]
+__all__ = ["DecodeEngine", "Heartbeat", "PoissonLoadGen", "PrefixIndex",
+           "Request", "Router", "Scheduler", "ServeClient",
+           "decode_step_view", "replay"]

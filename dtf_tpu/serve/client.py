@@ -12,6 +12,9 @@ against static batched ``generate()`` rides
 from __future__ import annotations
 
 import dataclasses
+import json
+import logging
+import sys
 import time
 from typing import Iterator, Optional, Sequence
 
@@ -19,15 +22,19 @@ import numpy as np
 
 from dtf_tpu.serve.scheduler import Request, Scheduler
 
+log = logging.getLogger("dtf_tpu")
+
 
 def replay(scheduler: Scheduler, arrivals, *,
-           clock=time.perf_counter, sleep=time.sleep) -> float:
+           clock=time.perf_counter, sleep=time.sleep,
+           on_tick=None) -> float:
     """Open-loop arrival replay: submit each ``(t_arrival, Request)`` when
     its wall-clock moment comes, tick the scheduler whenever work is
     pending, and drain. Returns the makespan in seconds. THE one pump loop
     — serve_gpt.py and the bench A/B both drive it, so admission timing
     cannot drift between them. Returns request ids in submit order via
-    ``scheduler`` (callers poll)."""
+    ``scheduler`` (callers poll). ``on_tick`` (optional, zero-arg) fires
+    after every scheduler tick — the :class:`Heartbeat` hook point."""
     arrivals = list(arrivals)
     t0 = clock()
     i = 0
@@ -38,9 +45,91 @@ def replay(scheduler: Scheduler, arrivals, *,
             i += 1
         if scheduler.pending:
             scheduler.tick()
+            if on_tick is not None:
+                on_tick()
         elif i < len(arrivals):
             sleep(min(arrivals[i][0] - now, 0.05))
     return clock() - t0
+
+
+#: heartbeat snapshot keys, in emit order — the operator's at-a-glance
+#: panel (everything else stays in the final stats() line).
+_HEARTBEAT_KEYS = ("serve_completed", "serve_queue_depth",
+                   "serve_occupancy", "serve_ttft_p50_s",
+                   "serve_ttft_p99_s", "serve_ttft_slo_ok_frac",
+                   "router_completed", "router_queue_depth",
+                   "router_occupancy", "router_ttft_p50_s",
+                   "router_ttft_p99_s", "router_ttft_slo_ok_frac")
+
+
+class Heartbeat:
+    """Periodic one-line JSON liveness snapshots of a running server.
+
+    Call :meth:`maybe_emit` after every scheduler/router tick (``replay``'s
+    ``on_tick``, or the explicit pump loop): every ``every_ticks`` ticks it
+    emits one ``{"serve_heartbeat": ...}`` JSON line via ``emit`` (default:
+    stderr — stdout's LAST line stays the launcher's one metrics line) with
+    the scheduler/router ``stats()`` panel: per-replica occupancy, TTFT
+    p50/p99, and the SLO compliance fraction. When ``slo_floor > 0`` and
+    the ok-fraction drops below it, a WARNING logs once per excursion
+    (re-armed when compliance recovers — a sustained breach must not spam
+    one warning per tick). Host arithmetic only; stats() is already
+    readback-free.
+    """
+
+    def __init__(self, sched, *, every_ticks: int, slo_floor: float = 0.0,
+                 emit=None, clock=time.monotonic):
+        if every_ticks < 1:
+            raise ValueError(f"every_ticks={every_ticks} must be >= 1")
+        self.sched = sched
+        self.every_ticks = every_ticks
+        self.slo_floor = slo_floor
+        self.emit = emit or (lambda line: print(line, file=sys.stderr))
+        self.clock = clock
+        self._t0 = clock()
+        self._ticks = 0
+        self.emitted = 0
+        self._below_floor = False
+
+    def snapshot(self) -> dict:
+        stats = self.sched.stats()
+        snap = {"serve_heartbeat": self.emitted,
+                "t_s": round(self.clock() - self._t0, 3)}
+        for k in _HEARTBEAT_KEYS:
+            if k in stats:
+                snap[k] = (round(v, 6) if isinstance(v := stats[k], float)
+                           else v)
+        # the per-replica SLO panel (Router stats) rides along verbatim
+        for k, v in stats.items():
+            if k.startswith("replica"):
+                snap[k] = round(v, 6) if isinstance(v, float) else v
+        return snap
+
+    def _slo_ok_frac(self, snap) -> float | None:
+        for k in ("router_ttft_slo_ok_frac", "serve_ttft_slo_ok_frac"):
+            if k in snap:
+                return snap[k]
+        return None
+
+    def maybe_emit(self) -> dict | None:
+        self._ticks += 1
+        if self._ticks % self.every_ticks:
+            return None
+        snap = self.snapshot()
+        self.emitted += 1
+        self.emit(json.dumps(snap))
+        ok = self._slo_ok_frac(snap)
+        if self.slo_floor > 0.0 and ok is not None:
+            if ok < self.slo_floor and not self._below_floor:
+                self._below_floor = True
+                log.warning(
+                    "TTFT SLO compliance %.3f below the %.3f floor "
+                    "(p99 %.4fs)", ok, self.slo_floor,
+                    snap.get("router_ttft_p99_s",
+                             snap.get("serve_ttft_p99_s", 0.0)))
+            elif ok >= self.slo_floor:
+                self._below_floor = False
+        return snap
 
 
 class ServeClient:

@@ -57,6 +57,7 @@ ever dominates (docs/SERVING.md).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import math
 from typing import Any, Optional, Sequence
@@ -367,6 +368,14 @@ class DecodeEngine:
         self.counters = {"prefill_chunks": 0, "decode_steps": 0,
                          "pages_loaded": 0, "pages_saved": 0,
                          "prefix_hit_tokens": 0, "prefix_miss_tokens": 0}
+        #: when True, each compiled-program dispatch is wrapped in a
+        #: jax.profiler.TraceAnnotation carrying the request trace id(s) the
+        #: scheduler threaded down — a ProfilerHook window over a serving
+        #: run then shows WHICH requests each prefill/decode dispatch
+        #: served, joinable to the per-request chrome trace. Off by
+        #: default: a TraceMe outside any profiling session is cheap but
+        #: not free, and the id strings allocate per decode step.
+        self.annotate_traces = False
         if mesh is None:
             # a restored checkpoint carries the TRAINING mesh's shardings;
             # unsharded serving runs on one device, and the AOT-compiled
@@ -480,12 +489,22 @@ class DecodeEngine:
     def n_chunks(self, prompt_len: int) -> int:
         return math.ceil(prompt_len / self.prefill_chunk)
 
+    def _annotation(self, name: str, **ids):
+        """A jax.profiler.TraceAnnotation stamping request trace ids into
+        the XPlane timeline (``annotate_traces``); a null context
+        otherwise. Host-side marker only — never reads a device value."""
+        if not self.annotate_traces:
+            return contextlib.nullcontext()
+        return jax.profiler.TraceAnnotation(name, **ids)
+
     def prefill_chunk_into(self, slot: int, prompt: Sequence[int],
                            chunk_i: int, *, start: int = 0,
                            temperature: float = 0.0,
                            top_k: int = 0, top_p: float = 1.0,
                            eos_id: Optional[int] = None, pad_id: int = 0,
-                           seed: int = 0) -> Optional[tuple[int, bool]]:
+                           seed: int = 0,
+                           trace_id: Optional[int] = None
+                           ) -> Optional[tuple[int, bool]]:
         """Run prompt chunk ``chunk_i`` of a request into ``slot`` — the
         scheduler's prefill/decode interleave granularity (decode_all may
         run between chunks; the slot stays a masked spectator until its
@@ -515,13 +534,17 @@ class DecodeEngine:
         buf = np.zeros((c,), np.int32)
         buf[:len(seg)] = seg
         last = chunk_i == n - 1
-        self._state, out = self._prefill_c(
-            self._params, self._state, np.int32(slot), np.int32(start),
-            buf, np.int32(len(seg)), np.bool_(chunk_i == 0),
-            np.bool_(last), np.float32(temperature), np.int32(top_k),
-            np.float32(top_p), np.int32(-1 if eos_id is None else eos_id),
-            np.int32(pad_id),
-            np.asarray(jax.random.PRNGKey(seed), np.uint32))
+        with self._annotation("dtf.serve.prefill_chunk", slot=slot,
+                              chunk=chunk_i,
+                              trace_id=-1 if trace_id is None else trace_id):
+            self._state, out = self._prefill_c(
+                self._params, self._state, np.int32(slot), np.int32(start),
+                buf, np.int32(len(seg)), np.bool_(chunk_i == 0),
+                np.bool_(last), np.float32(temperature), np.int32(top_k),
+                np.float32(top_p),
+                np.int32(-1 if eos_id is None else eos_id),
+                np.int32(pad_id),
+                np.asarray(jax.random.PRNGKey(seed), np.uint32))
         self.counters["prefill_chunks"] += 1
         if not last:
             return None
@@ -544,12 +567,18 @@ class DecodeEngine:
                                           **sampling)
         return out
 
-    def decode(self) -> tuple[np.ndarray, np.ndarray]:
+    def decode(self, *, trace_ids: Optional[Sequence[int]] = None
+               ) -> tuple[np.ndarray, np.ndarray]:
         """One masked token step across all slots. Returns
         ``(tokens [n_slots], done [n_slots])`` as host arrays — the one
         device→host sync per generated token (EOS and delivery decisions
-        live on the host)."""
-        self._state, out = self._decode_c(self._params, self._state)
+        live on the host). ``trace_ids`` (scheduler-threaded) names the
+        requests this step serves in the XPlane annotation."""
+        with self._annotation(
+                "dtf.serve.decode",
+                trace_ids="" if trace_ids is None
+                else ",".join(map(str, trace_ids))):
+            self._state, out = self._decode_c(self._params, self._state)
         self.counters["decode_steps"] += 1
         return np.asarray(out["token"]), np.asarray(out["done"])
 

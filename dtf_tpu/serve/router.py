@@ -57,8 +57,15 @@ class Router:
         self.telemetry = telemetry
         self.schedulers = [
             Scheduler(e, writer, telemetry=telemetry,
-                      ttft_slo_s=ttft_slo_s, clock=clock, **scheduler_kw)
+                      ttft_slo_s=ttft_slo_s, clock=clock,
+                      postmortem_name=None, **scheduler_kw)
             for e in engines]
+        if telemetry is not None:
+            # ONE aggregate postmortem provider for the fleet (each
+            # replica's provider would collide on the name): in-flight
+            # request ids + slot ages per replica, host facts only.
+            telemetry.add_postmortem_provider(
+                "serve_router", self.postmortem_state)
         self.ttft_slo_s = ttft_slo_s
         self._where: dict[int, tuple[int, int]] = {}
         self._next_id = 0
@@ -94,8 +101,13 @@ class Router:
 
     def submit(self, req: Request) -> int:
         i = self._pick()
-        local = self.schedulers[i].submit(req)
+        # the fleet-global rid IS the request's trace id: every span the
+        # replica scheduler and engine record for it carries this one id,
+        # so a request renders end-to-end across the tiers in Perfetto.
+        # Increment only after the replica ACCEPTED — a rejected submit
+        # (over-long prompt) must not consume a fleet id.
         rid = self._next_id
+        local = self.schedulers[i].submit(req, trace_id=rid)
         self._next_id += 1
         self._where[rid] = (i, local)
         return rid
@@ -103,6 +115,12 @@ class Router:
     def replica_of(self, rid: int) -> int:
         """Which replica holds request ``rid`` (admission audit)."""
         return self._where[rid][0]
+
+    def postmortem_state(self) -> dict:
+        """Fleet postmortem context: per-replica in-flight request ids and
+        slot ages (host facts only — the flight-recorder dump contract)."""
+        return {f"replica{i}": s.postmortem_state()
+                for i, s in enumerate(self.schedulers)}
 
     # ----------------------------------------------------------- pump surface
 
@@ -117,11 +135,14 @@ class Router:
             if s.pending:
                 s.tick()
 
-    def run_until_idle(self, max_ticks: int = 100000) -> None:
+    def run_until_idle(self, max_ticks: int = 100000, *,
+                       on_tick=None) -> None:
         for _ in range(max_ticks):
             if not self.pending:
                 return
             self.tick()
+            if on_tick is not None:
+                on_tick()
         raise RuntimeError(f"requests still pending after {max_ticks} ticks")
 
     def poll(self, rid: int) -> dict:

@@ -70,6 +70,12 @@ class _Rec:
     #: far, released on slot evict (the refcount contract).
     handle: object = None
     pages_loaded: int = 0
+    #: end-to-end trace id (router-assigned global rid when behind one;
+    #: the local rid otherwise) — tags every span/trace event this
+    #: request touches, through scheduler and engine alike.
+    trace_id: int = -1
+    #: submit moment on the TraceCollector's clock (chrome ts domain)
+    submit_us: float = 0.0
 
 
 class Scheduler:
@@ -83,11 +89,20 @@ class Scheduler:
     def __init__(self, engine, writer=None, *, log_every: int = 0,
                  prefill_chunks_per_tick: int = 4, clock=time.monotonic,
                  completed_cap: int = 100_000, telemetry=None,
-                 ttft_slo_s: float = 0.0):
+                 ttft_slo_s: float = 0.0,
+                 postmortem_name: Optional[str] = "serve_scheduler"):
         self.engine = engine
         self.writer = writer
         self.log_every = log_every
         self.telemetry = telemetry
+        if telemetry is not None and postmortem_name:
+            # the serve postmortem: a crash/stall/SIGTERM dump names the
+            # in-flight request ids + per-slot ages (host facts only —
+            # the dump path must not touch a wedged backend). The Router
+            # registers ONE aggregate provider instead (postmortem_name
+            # None for its replica schedulers).
+            telemetry.add_postmortem_provider(
+                postmortem_name, self.postmortem_state)
         #: TTFT service-level objective (0 = untracked): ``stats()`` then
         #: reports the fraction of completed first tokens inside it — the
         #: per-replica SLO rollup the router surfaces (docs/SERVING.md).
@@ -125,7 +140,10 @@ class Scheduler:
 
     # ----------------------------------------------------------- submit/poll
 
-    def submit(self, req: Request) -> int:
+    def submit(self, req: Request, *, trace_id: Optional[int] = None) -> int:
+        """Accept a request; returns the local rid. ``trace_id`` threads an
+        end-to-end id through every span this request touches (the Router
+        passes its fleet-global rid; standalone, the local rid is the id)."""
         if not 1 <= len(req.prompt) <= self.engine.max_len - 1:
             raise ValueError(
                 f"prompt length {len(req.prompt)} must be in "
@@ -134,7 +152,11 @@ class Scheduler:
             raise ValueError(f"max_new={req.max_new} must be >= 1")
         rid = self._next_id
         self._next_id += 1
-        rec = _Rec(rid, req, submit_t=self.clock())
+        rec = _Rec(rid, req, submit_t=self.clock(),
+                   trace_id=rid if trace_id is None else trace_id)
+        tracer = self._tracer()
+        if tracer is not None:
+            rec.submit_us = tracer.now_us()
         self._recs[rid] = rec
         self._queue.append(rec)
         self._queue_peak = max(self._queue_peak, len(self._queue))
@@ -169,6 +191,12 @@ class Scheduler:
                 if self.telemetry is not None:
                     self.telemetry.spans.add(
                         "router_wait", self.clock() - rec.submit_t)
+                    tracer = self._tracer()
+                    if tracer is not None:
+                        tracer.complete(
+                            "queue_wait", cat="request", tid=rec.trace_id,
+                            t0_us=rec.submit_us, t1_us=tracer.now_us(),
+                            args={"slot": rec.slot})
                 # prefix-page lookup at admission (None with the cache
                 # off): the pinned chain loads below, on the same budget
                 pm = getattr(self.engine, "prefix_match", None)
@@ -182,23 +210,31 @@ class Scheduler:
                 # unit (it still spends budget so admission cannot starve
                 # decode, and the load deactivates the slot first)
                 self._timed("serve_page_load", self.engine.load_prefix,
-                            rec.slot, rec.handle)
+                            rec.slot, rec.handle, tid=rec.trace_id)
                 rec.pages_loaded = len(rec.handle.entries)
                 budget -= 1
                 continue
             start = rec.handle.n_tokens if rec.handle is not None else 0
+            # the trace id reaches the ENGINE (XPlane annotation) only
+            # when it opted in — simple engines need not know about ids
+            ekw = ({"trace_id": rec.trace_id}
+                   if getattr(self.engine, "annotate_traces", False)
+                   else {})
             out = self._timed(
                 "serve_prefill_chunk", self.engine.prefill_chunk_into,
                 rec.slot, r.prompt, rec.chunks_done, start=start,
                 temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
-                eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed)
+                eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed,
+                tid=rec.trace_id,
+                targs={"slot": rec.slot, "chunk": rec.chunks_done}, **ekw)
             rec.chunks_done += 1
             budget -= 1
             if out is not None:                      # last chunk: tok0
                 tok, done = out
                 save = getattr(self.engine, "save_prefix_pages", None)
                 if save is not None:
-                    self._timed("serve_page_save", save, rec.slot, r.prompt)
+                    self._timed("serve_page_save", save, rec.slot, r.prompt,
+                                tid=rec.trace_id)
                 rec.first_token_t = self.clock()
                 rec.tokens.append(tok)
                 self._admitting = None
@@ -210,7 +246,19 @@ class Scheduler:
                     self._running[rec.slot] = rec
 
         if self._running:
-            toks, dones = self._timed("serve_decode", self.engine.decode)
+            if self.telemetry is None \
+                    and not getattr(self.engine, "annotate_traces", False):
+                # hottest loop, telemetry off: no per-token id-list /
+                # targs allocation for data nothing would consume
+                toks, dones = self.engine.decode()
+            else:
+                active = [r.trace_id for r in self._running.values()]
+                ekw = ({"trace_ids": active}
+                       if getattr(self.engine, "annotate_traces", False)
+                       else {})
+                toks, dones = self._timed(
+                    "serve_decode", self.engine.decode,
+                    targs={"trace_ids": active}, **ekw)
             now = self.clock()
             for slot, rec in list(self._running.items()):
                 rec.tokens.append(int(toks[slot]))
@@ -223,21 +271,45 @@ class Scheduler:
                 and self._tick % self.log_every == 0):
             self.writer.write_scalars(self._tick, self.stats(brief=True))
 
-    def run_until_idle(self, max_ticks: int = 100000) -> None:
+    def run_until_idle(self, max_ticks: int = 100000, *,
+                       on_tick=None) -> None:
+        """Drain the queue. ``on_tick`` (zero-arg, optional) fires after
+        every tick — the heartbeat hook point, shared with replay()."""
         for _ in range(max_ticks):
             if not self.pending:
                 return
             self.tick()
+            if on_tick is not None:
+                on_tick()
         raise RuntimeError(f"requests still pending after {max_ticks} ticks")
 
     # ------------------------------------------------------------- internals
 
-    def _timed(self, name, fn, *args, **kwargs):
-        """Engine call under a telemetry phase span (no-op without one)."""
+    def _tracer(self):
+        """The run's per-request TraceCollector, if one is attached to the
+        telemetry object (host-clock chrome events; None = no recording)."""
+        return getattr(self.telemetry, "tracer", None)
+
+    def _timed(self, name, fn, *args, tid=None, targs=None, **kwargs):
+        """Engine call under a telemetry phase span (no-op without one);
+        with a TraceCollector attached, additionally one chrome event
+        tagged ``tid`` (the request trace id; the shared "engine" track
+        for decode steps serving many requests at once). All host
+        perf_counter arithmetic — zero added device readbacks."""
         if self.telemetry is None:
             return fn(*args, **kwargs)
-        with self.telemetry.spans.span(name):
-            return fn(*args, **kwargs)
+        tracer = self._tracer()
+        if tracer is None:
+            with self.telemetry.spans.span(name):
+                return fn(*args, **kwargs)
+        t0 = tracer.now_us()
+        try:
+            with self.telemetry.spans.span(name):
+                return fn(*args, **kwargs)
+        finally:
+            tracer.complete(name, cat="engine",
+                            tid="engine" if tid is None else tid,
+                            t0_us=t0, t1_us=tracer.now_us(), args=targs)
 
     def _budget_spent(self, rec: _Rec) -> bool:
         return (len(rec.tokens) >= rec.req.max_new
@@ -263,6 +335,17 @@ class Scheduler:
     def _finish(self, rec: _Rec) -> None:
         rec.status = "done"
         rec.finish_t = rec.finish_t or self.clock()
+        tracer = self._tracer()
+        if tracer is not None:
+            # the request's whole lifecycle as ONE slice on its own track
+            # — renders submit → done in Perfetto with the engine-call
+            # slices (tagged with the same trace id) nested visually
+            tracer.complete(
+                "request", cat="request", tid=rec.trace_id,
+                t0_us=rec.submit_us, t1_us=tracer.now_us(),
+                args={"rid": rec.rid, "prompt_len": len(rec.req.prompt),
+                      "tokens": len(rec.tokens),
+                      "ttft_s": round(rec.first_token_t - rec.submit_t, 6)})
         if rec.handle is not None:       # refcount release on slot evict
             self.engine.release_prefix(rec.handle)
             rec.handle = None
@@ -285,6 +368,32 @@ class Scheduler:
         rec = self._recs.get(rid)
         if rec is not None and rec.status == "done":
             self._recs.pop(rid, None)
+
+    # ----------------------------------------------------------- postmortem
+
+    def postmortem_state(self) -> dict:
+        """In-flight request ids + per-slot ages for the flight-recorder
+        dump — pure host clocks and counters (the dump fires exactly when
+        the backend may be wedged, so NO device API on this path)."""
+        now = self.clock()
+        in_flight, slot_ages = [], {}
+        recs = list(self._queue)
+        if self._admitting is not None:
+            recs.append(self._admitting)
+        recs += list(self._running.values())
+        for rec in recs:
+            in_flight.append({
+                "rid": rec.rid, "trace_id": rec.trace_id,
+                "status": rec.status, "slot": rec.slot,
+                "age_s": round(now - rec.submit_t, 3),
+                "tokens": len(rec.tokens)})
+            if rec.slot >= 0:
+                slot_ages[str(rec.slot)] = round(now - rec.submit_t, 3)
+        return {"in_flight": in_flight,
+                "queue_depth": len(self._queue),
+                "occupancy": round(self._occupancy(), 4),
+                "slot_ages_s": slot_ages,
+                "completed": self._completed}
 
     # --------------------------------------------------------------- metrics
 

@@ -1,0 +1,125 @@
+"""``python -m dtf_tpu.telemetry report`` — device-profile analytics, ONE
+JSON line (bench.py idiom: stdout's last line is always one JSON object).
+
+    python -m dtf_tpu.telemetry report --logdir=/tmp/run/profile
+    python -m dtf_tpu.telemetry report --logdir=... --hlo=step.hlo.txt \
+        --flops=1.2e12 --peak=1.97e14 --n-devices=8 --chrome=trace.json
+
+Parses the newest XPlane session under ``--logdir`` into per-category
+device-time buckets, per-collective ``file:line`` provenance rows (when
+``--hlo`` supplies the optimized HLO text of the profiled program(s)),
+comm/compute overlap efficiency, and — with ``--flops``/``--peak`` — the
+device-derived MFU cross-check. ``--chrome`` additionally writes a
+Perfetto-loadable chrome-trace JSON of the device slices.
+
+Parsing needs no backend, but importing the ``dtf_tpu`` package pulls
+jax, and a jax import can hang when the axon tunnel env is set and dead
+(CLAUDE.md) — so like ``python -m dtf_tpu.analysis`` this re-execs into a
+scrubbed CPU env first. Exit 0 even on a degraded parse (the reason rides
+inside the JSON); exit 2 only when the reporter itself crashed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _reexec_if_needed(argv: list[str]) -> None:
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.path.insert(0, root)
+    from _dtf_env import cpu_sim_env, is_cpu_sim
+
+    if is_cpu_sim(os.environ, 1):
+        return
+    if os.environ.get("_DTF_TPU_TELEMETRY_REEXEC") == "1":
+        return
+    import subprocess
+
+    env = cpu_sim_env(1, os.environ)
+    env["_DTF_TPU_TELEMETRY_REEXEC"] = "1"
+    env.setdefault("PYTHONPATH", root)
+    proc = subprocess.run(
+        [sys.executable, "-m", "dtf_tpu.telemetry"] + argv,
+        env=env, cwd=root, timeout=600)
+    sys.exit(proc.returncode)
+
+
+def _run_report(args) -> dict:
+    from dtf_tpu.telemetry import profile as profile_mod
+
+    site_map = None
+    if args.hlo:
+        from dtf_tpu.analysis.provenance import profile_site_map
+
+        texts = []
+        for p in args.hlo:
+            with open(p) as f:
+                texts.append(f.read())
+        site_map = profile_site_map(texts)
+    report = profile_mod.parse_logdir(
+        args.logdir, site_map=site_map, step_name=args.step_name,
+        model_flops_per_step=args.flops, peak_flops=args.peak,
+        n_devices=args.n_devices)
+    report["telemetry"] = "device_profile"
+    if args.chrome:
+        from dtf_tpu.telemetry.xplane import load_trace
+
+        trace, reason = load_trace(args.logdir, step_name=args.step_name)
+        if trace is not None:
+            profile_mod.export_chrome_trace(args.chrome, trace=trace)
+            report["chrome_trace"] = args.chrome
+        else:
+            report["chrome_trace_error"] = reason
+    return report
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    try:
+        _reexec_if_needed(argv)
+    except SystemExit:
+        raise
+    except Exception as e:  # noqa: BLE001 — the JSON-last-line contract
+        print(json.dumps({"telemetry": "device_profile",
+                          "error": f"reexec failed: {e}"}))
+        return 2
+    p = argparse.ArgumentParser(prog="python -m dtf_tpu.telemetry")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="parse an XPlane trace dir")
+    rep.add_argument("--logdir", required=True,
+                     help="profiler logdir (the ProfilerHook dir or a "
+                          "plugins/profile/<ts> session)")
+    rep.add_argument("--hlo", action="append", default=[],
+                     help="optimized-HLO text file(s) of the profiled "
+                          "program(s) for the file:line provenance join; "
+                          "repeatable")
+    rep.add_argument("--chrome", default="",
+                     help="also write a Perfetto chrome-trace JSON here")
+    rep.add_argument("--step-name", default="train",
+                     help="StepTraceAnnotation name bounding each step")
+    rep.add_argument("--flops", type=float, default=None,
+                     help="model FLOPs per step (device-MFU cross-check)")
+    rep.add_argument("--peak", type=float, default=None,
+                     help="per-chip peak FLOP/s (default: v5e bf16)")
+    rep.add_argument("--n-devices", type=int, default=1)
+    args = p.parse_args(argv)
+    if args.peak is None and args.flops is not None:
+        from dtf_tpu.telemetry.accounting import V5E_PEAK_BF16_FLOPS
+
+        args.peak = V5E_PEAK_BF16_FLOPS
+    try:
+        report = _run_report(args)
+    except Exception as e:  # noqa: BLE001 — one JSON line no matter what
+        print(json.dumps({"telemetry": "device_profile",
+                          "error": f"{type(e).__name__}: {e}"}))
+        return 2
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

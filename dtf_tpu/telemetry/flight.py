@@ -62,6 +62,12 @@ class FlightRecorder:
         self.last_scalars: dict = {}
         self.last_step_t: Optional[float] = None   # clock() domain
         self.dumps = 0
+        #: postmortem context providers (add_provider): host-fact callables
+        #: merged into every dump under "context" — the serve tier
+        #: registers its in-flight request ids + per-slot ages here. The
+        #: NO-device-API constraint extends to providers: they run while
+        #: the backend may be wedged, so host state only.
+        self._providers: dict[str, object] = {}
         # REENTRANT: the SIGTERM postmortem handler runs dump() on the
         # main thread between bytecodes — if the signal lands inside
         # record_step's critical section (every step), a plain Lock would
@@ -98,6 +104,19 @@ class FlightRecorder:
         with self._lock:
             return [r["step_s"] for r in self.records if "step_s" in r]
 
+    def add_provider(self, name: str, fn) -> None:
+        """Register a postmortem context provider: ``fn() -> dict`` of
+        HOST facts (no device API — it runs against a possibly-wedged
+        backend), merged into every dump under ``context[name]``. A
+        provider that raises is reported as its error string instead of
+        masking the postmortem (dump() never raises). Re-registering a
+        name replaces it; ``fn=None`` removes it."""
+        with self._lock:
+            if fn is None:
+                self._providers.pop(name, None)
+            else:
+                self._providers[name] = fn
+
     # ----------------------------------------------------------------- dump
 
     def dump(self, reason: str, extra: Optional[Mapping] = None) -> dict:
@@ -117,6 +136,15 @@ class FlightRecorder:
             rss = _rss_mb()
             if rss is not None:
                 post["rss_mb"] = rss
+            if self._providers:
+                ctx = {}
+                for name, fn in self._providers.items():
+                    try:
+                        ctx[name] = fn()
+                    except Exception as e:  # noqa: BLE001 — a provider
+                        # failure must not mask the primary postmortem
+                        ctx[name] = {"provider_error": repr(e)[:200]}
+                post["context"] = ctx
             if extra:
                 post.update(extra)
             self.dumps += 1

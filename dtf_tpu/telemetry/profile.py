@@ -1,0 +1,338 @@
+"""Device-time attribution over a parsed XPlane trace — where the other
+(1 − MFU) goes.
+
+PR 5's telemetry decomposes the HOST side of a step; this module is the
+device half (the per-op breakdowns the pjit-TPUv4 and MLPerf-pod scaling
+papers use to find their losses, PAPERS.md 2204.06514 / 1909.09756):
+
+- **buckets** — per-step device time split into MXU matmuls, flash/Pallas
+  custom calls, fusions, and collectives by kind;
+- **provenance join** — every collective's device seconds attributed to
+  the Python ``file:line`` that issued it, by joining the event's
+  instruction name (``all-reduce.2``) against the optimized-HLO source
+  metadata (:func:`dtf_tpu.analysis.provenance.instruction_sites`);
+- **overlap efficiency** — the fraction of collective device time hidden
+  behind concurrent compute (the PR 2 ppermute rings claim latency
+  hiding; this measures it): ``hidden = 1 − exposed/total`` where
+  ``exposed`` is collective time with no compute running on the same
+  plane. TPU planes are per-device so the semantics are exact; the CPU
+  sim folds 8 virtual devices into one host plane, making sim overlap an
+  approximate logic check (documented in docs/OBSERVABILITY.md);
+- **device MFU** — flops/step against the measured device-side step
+  window, cross-checking the analytic steps/sec MFU.
+
+Everything here is pure arithmetic over :class:`~dtf_tpu.telemetry.xplane.
+TraceData` — no jax, no tensorflow at module level (the srclint
+lazy-import fence), so reports can be generated on a machine with no
+backend from a trace captured on a chip.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Mapping, Optional, Sequence
+
+from dtf_tpu.telemetry.xplane import OpEvent, StepWindow, TraceData
+
+#: collective opcode prefixes (async -start/-done forms ride the prefix);
+#: mirrors analysis/hlo.py COLLECTIVE_OPS without importing it (that
+#: module is jax-adjacent via the analysis package's siblings).
+COLLECTIVE_KINDS = ("all-reduce", "all-gather", "reduce-scatter",
+                    "collective-permute", "all-to-all")
+
+#: bucket names, in report order.
+BUCKETS = ("matmul", "pallas", "fusion") + COLLECTIVE_KINDS + (
+    "data", "other")
+
+_MATMUL_PREFIXES = ("dot", "convolution", "cublas", "custom-call-matmul")
+_DATA_PREFIXES = ("copy", "transpose", "bitcast", "reshape", "infeed",
+                  "outfeed", "dynamic-slice", "dynamic-update-slice",
+                  "slice", "concatenate", "broadcast", "iota", "constant",
+                  "tuple", "get-tuple-element", "parameter")
+_PALLAS_MARKERS = ("pallas", "flash", "tpu_custom_call", "mosaic")
+
+
+def base_op_name(name: str) -> str:
+    """Instruction name → opcode-ish base: strip the ``.N`` instance
+    suffix and async ``-start``/``-done`` markers (one transfer)."""
+    base = name.split(".")[0]
+    for suf in ("-start", "-done"):
+        if base.endswith(suf):
+            base = base[: -len(suf)]
+    return base
+
+
+def categorize(name: str, category_stat: str = "") -> str:
+    """Map one op event into a report bucket.
+
+    The backend's ``hlo_category`` stat wins when it names something we
+    bucket (TPU planes carry it); otherwise the instruction name decides —
+    collectives first (a fusion can't absorb one), then Pallas markers
+    (custom-call names keep the kernel name), matmuls, fusions, data
+    movement, ``other``.
+    """
+    low = name.lower()
+    cat = category_stat.lower()
+    base = base_op_name(low)
+    for kind in COLLECTIVE_KINDS:
+        if base.startswith(kind) or kind in cat:
+            return kind
+    if any(m in low or m in cat for m in _PALLAS_MARKERS):
+        return "pallas"
+    if base.startswith(_MATMUL_PREFIXES) or "convolution" in cat:
+        return "matmul"
+    if "fusion" in low or "fusion" in cat:
+        # fusions whose name records a dot root are MXU work
+        return "matmul" if "dot" in low else "fusion"
+    if base.startswith(_DATA_PREFIXES):
+        return "data"
+    return "other"
+
+
+# ---------------------------------------------------------------- intervals
+
+def _union(intervals: Sequence[tuple]) -> list[tuple]:
+    """Merged, sorted (start, end) union of possibly-overlapping spans."""
+    out: list[list] = []
+    for s, e in sorted(intervals):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def _covered(span: tuple, union: Sequence[tuple]) -> int:
+    """Length of ``span`` covered by the (merged, sorted) ``union``."""
+    s, e = span
+    cov = 0
+    for us, ue in union:
+        if ue <= s:
+            continue
+        if us >= e:
+            break
+        cov += min(e, ue) - max(s, us)
+    return cov
+
+
+def _total(union: Sequence[tuple]) -> int:
+    return sum(e - s for s, e in union)
+
+
+# ----------------------------------------------------------------- analyze
+
+def _in_windows(events: Sequence[OpEvent],
+                windows: Sequence[StepWindow]) -> list[OpEvent]:
+    """Events whose midpoint falls inside any step window (none → all
+    events pass: a trace without step annotations still buckets)."""
+    if not windows:
+        return list(events)
+    return [ev for ev in events
+            if any(w.contains(ev.start_ps + ev.dur_ps // 2)
+                   for w in windows)]
+
+
+def analyze(trace: TraceData, *, site_map: Optional[Mapping] = None,
+            model_flops_per_step: Optional[float] = None,
+            peak_flops: Optional[float] = None,
+            n_devices: int = 1) -> dict:
+    """The device-profile report dict (see module docstring).
+
+    ``site_map`` is :func:`dtf_tpu.analysis.provenance.profile_site_map`
+    output — ``{instruction_name: {"op", "loc", "bytes"}}`` — absent, the
+    collective rows still carry device time, just no ``file:line``.
+    Degrades with a reason instead of raising on an empty trace.
+    """
+    windows = trace.step_windows
+    events = _in_windows(trace.op_events, windows)
+    out: dict = {
+        "n_op_events": len(events),
+        # raw count next to the windowed one: a device/host clock-domain
+        # mismatch (events all falling outside the step windows) reads as
+        # total >> windowed here instead of a silently empty report
+        "n_op_events_total": len(trace.op_events),
+        "n_steps": len(windows),
+        "device_planes": len(trace.device_planes),
+        "per_op_events": bool(trace.op_events),
+    }
+    if trace.op_events and windows and not events:
+        out["degraded"] = ("all per-op events fall outside the step "
+                           "windows (clock-domain mismatch between "
+                           "device planes and host annotations?)")
+    if not trace.op_events:
+        out["degraded"] = ("no per-op device events in trace (CPU backend "
+                           "without --xla_cpu_enable_xprof_traceme, or an "
+                           "empty window)")
+
+    # ---- per-category buckets -------------------------------------------
+    bucket_ps = {b: 0 for b in BUCKETS}
+    bucket_n = {b: 0 for b in BUCKETS}
+    for ev in events:
+        b = categorize(ev.name, ev.category)
+        bucket_ps[b] += ev.dur_ps
+        bucket_n[b] += 1
+    total_ps = sum(bucket_ps.values())
+    out["buckets"] = {
+        b: {"time_ms": round(bucket_ps[b] / 1e9, 4), "count": bucket_n[b],
+            "frac": round(bucket_ps[b] / total_ps, 4) if total_ps else 0.0}
+        for b in BUCKETS if bucket_n[b]}
+    out["device_time_ms"] = round(total_ps / 1e9, 4)
+
+    # ---- per-collective provenance rows ---------------------------------
+    rows: dict[tuple, dict] = {}
+    for ev in events:
+        kind = categorize(ev.name, ev.category)
+        if kind not in COLLECTIVE_KINDS:
+            continue
+        site = (site_map or {}).get(ev.name) \
+            or (site_map or {}).get(base_op_name(ev.name))
+        loc = site["loc"] if site else "<unattributed>"
+        row = rows.setdefault((kind, loc), {
+            "kind": kind, "loc": loc, "time_ms": 0.0, "count": 0,
+            "hlo_ops": set()})
+        row["time_ms"] += ev.dur_ps / 1e9
+        row["count"] += 1
+        row["hlo_ops"].add(ev.name)
+    out["collectives"] = [
+        {**r, "time_ms": round(r["time_ms"], 4),
+         "hlo_ops": sorted(r["hlo_ops"])}
+        for r in sorted(rows.values(),
+                        key=lambda r: -r["time_ms"])]
+
+    # ---- overlap efficiency ---------------------------------------------
+    out["overlap"] = overlap_efficiency(events)
+
+    # ---- step timing + device MFU ---------------------------------------
+    if windows:
+        wall_ps = [w.end_ps - w.start_ps for w in windows]
+        mean_wall = sum(wall_ps) / len(wall_ps)
+        busy = []
+        for w in windows:
+            per_plane = {}
+            for ev in events:
+                mid = ev.start_ps + ev.dur_ps // 2
+                if w.contains(mid):
+                    per_plane.setdefault(ev.plane, []).append(
+                        (ev.start_ps, ev.end_ps))
+            if per_plane:
+                busy.append(sum(_total(_union(iv))
+                                for iv in per_plane.values())
+                            / len(per_plane))
+        mean_busy = sum(busy) / len(busy) if busy else 0.0
+        out["steps"] = {
+            "n": len(windows),
+            "step_wall_ms_mean": round(mean_wall / 1e9, 4),
+            "device_busy_ms_mean": round(mean_busy / 1e9, 4),
+            "device_busy_frac": round(mean_busy / mean_wall, 4)
+            if mean_wall else 0.0,
+            "device_idle_ms_mean": round(
+                max(mean_wall - mean_busy, 0.0) / 1e9, 4),
+        }
+        if model_flops_per_step and peak_flops and mean_wall > 0:
+            # device-side cross-check of the analytic steps/sec MFU: the
+            # same flops over the measured in-trace step window (host
+            # inter-step gaps excluded — if this is far ABOVE the run
+            # MFU, the host loop, not the device, is the bottleneck)
+            out["mfu_device"] = round(
+                model_flops_per_step
+                / (mean_wall / 1e12 * peak_flops * max(n_devices, 1)), 8)
+    return out
+
+
+def overlap_efficiency(events: Sequence[OpEvent]) -> dict:
+    """Per-collective-kind hidden-time fractions.
+
+    For each plane, compute intervals = union of every NON-collective op
+    slice; a collective slice's ``exposed`` time is whatever that union
+    does not cover. ``hidden_frac`` is the latency-hiding score the
+    ppermute rings (``collective-permute`` rows) are built for: 1.0 means
+    fully overlapped with compute, 0.0 means the step stalls for the
+    whole transfer. Kinds absent from the trace are omitted.
+    """
+    compute_by_plane: dict[str, list] = {}
+    coll_by_plane: dict[str, list] = {}
+    for ev in events:
+        kind = categorize(ev.name, ev.category)
+        if kind in COLLECTIVE_KINDS:
+            coll_by_plane.setdefault(ev.plane, []).append((kind, ev))
+        else:
+            compute_by_plane.setdefault(ev.plane, []).append(
+                (ev.start_ps, ev.end_ps))
+    totals: dict[str, list] = {}
+    for plane, colls in coll_by_plane.items():
+        comp = _union(compute_by_plane.get(plane, []))
+        for kind, ev in colls:
+            span = (ev.start_ps, ev.end_ps)
+            hidden = _covered(span, comp)
+            t = totals.setdefault(kind, [0, 0])
+            t[0] += ev.dur_ps
+            t[1] += hidden
+    out = {}
+    for kind, (total, hidden) in sorted(totals.items()):
+        out[kind] = {
+            "time_ms": round(total / 1e9, 4),
+            "hidden_ms": round(hidden / 1e9, 4),
+            "exposed_ms": round((total - hidden) / 1e9, 4),
+            "hidden_frac": round(hidden / total, 4) if total else 0.0,
+        }
+    return out
+
+
+def parse_logdir(logdir: str, *, site_map: Optional[Mapping] = None,
+                 step_name: str = "train", **analyze_kw) -> dict:
+    """Load the newest trace session under ``logdir`` and analyze it.
+    Tolerant end to end: every failure mode returns a dict with a
+    ``degraded`` reason rather than raising (the report CLI and
+    ProfilerHook both call this on arbitrary run state)."""
+    from dtf_tpu.telemetry.xplane import load_trace
+
+    trace, reason = load_trace(logdir, step_name=step_name)
+    if trace is None:
+        return {"n_op_events": 0, "n_steps": 0, "degraded": reason}
+    report = analyze(trace, site_map=site_map, **analyze_kw)
+    report["trace_dir"] = trace.path
+    return report
+
+
+# ------------------------------------------------------------ chrome trace
+
+def chrome_trace_events(trace: TraceData) -> list[dict]:
+    """Device/op slices as chrome-trace complete events (``ph: "X"``,
+    microsecond timestamps) — one ``pid`` per plane, ``tid`` per line, so
+    Perfetto renders each device as its own track group."""
+    events = []
+    for w in trace.step_windows:
+        events.append({"name": f"{w.name} {w.step}", "ph": "X",
+                       "cat": "step", "pid": "steps", "tid": w.name,
+                       "ts": w.start_ps / 1e6,
+                       "dur": (w.end_ps - w.start_ps) / 1e6,
+                       "args": {"step": w.step}})
+    for ev in trace.op_events:
+        events.append({"name": ev.name, "ph": "X",
+                       "cat": categorize(ev.name, ev.category),
+                       "pid": ev.plane, "tid": ev.line,
+                       "ts": ev.start_ps / 1e6, "dur": ev.dur_ps / 1e6})
+    return events
+
+
+def export_chrome_trace(path: str, *, trace: Optional[TraceData] = None,
+                        request_events: Optional[Sequence[Mapping]] = None,
+                        meta: Optional[Mapping] = None) -> dict:
+    """One Perfetto-loadable chrome-trace JSON: request lifecycles (the
+    serve :class:`~dtf_tpu.telemetry.trace.TraceCollector` output) next
+    to device slices. The two clock domains share only a best-effort
+    zero (each is relative to its own capture start); within a domain
+    ordering and durations are exact — docs/OBSERVABILITY.md walks the
+    Perfetto workflow."""
+    doc: dict = {"traceEvents": [], "displayTimeUnit": "ms"}
+    if meta:
+        doc["metadata"] = dict(meta)
+    if trace is not None:
+        doc["traceEvents"] += chrome_trace_events(trace)
+    if request_events:
+        doc["traceEvents"] += [dict(e) for e in request_events]
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return doc

@@ -69,6 +69,11 @@ class Telemetry:
         self.model_flops_per_step: Optional[float] = None
         self.throughput_name = "tokens_per_sec"
         self.clock = clock
+        #: per-request trace events (serving tier) — None unless a caller
+        #: attaches a TraceCollector; recording stays host-clock-only
+        self.tracer = None
+        #: last ProfilerHook device-profile report (note_device_profile)
+        self.device_profile: Optional[dict] = None
         self._t_start: Optional[float] = None
         self._t_stop: Optional[float] = None
         self._steps = 0
@@ -195,6 +200,18 @@ class Telemetry:
                         extra: Optional[Mapping] = None) -> dict:
         return self.flight.dump(reason, extra)
 
+    def add_postmortem_provider(self, name: str, fn) -> None:
+        """Register a flight-recorder context provider (host facts only —
+        see :meth:`FlightRecorder.add_provider`); the serve tier hangs its
+        in-flight request ids + slot ages here."""
+        self.flight.add_provider(name, fn)
+
+    def note_device_profile(self, report: Mapping) -> None:
+        """Record a ProfilerHook window's parsed device profile; a compact
+        summary rides the RunReport (full detail stays in the hook's
+        ``device_profile.json``)."""
+        self.device_profile = dict(report)
+
     # -------------------------------------------------------------- report
 
     def wall_s(self) -> float:
@@ -247,6 +264,12 @@ class Telemetry:
                     / (self.peak_flops * self.n_devices), 8)
         if self.flight.last_scalars:
             out["last_scalars"] = dict(self.flight.last_scalars)
+        if self.device_profile is not None:
+            dp = self.device_profile
+            out["device_profile"] = {
+                k: dp[k] for k in ("buckets", "overlap", "steps",
+                                   "mfu_device", "device_time_ms",
+                                   "degraded") if k in dp}
         if extra:
             out.update(extra)
         return out

@@ -1,0 +1,221 @@
+"""XPlane proto access — the raw half of device-time attribution.
+
+``jax.profiler.start_trace`` (ProfilerHook's window) writes one
+``*.xplane.pb`` per host under ``<logdir>/plugins/profile/<ts>/``. This
+module loads those protos via the installed
+``tensorflow.tsl.profiler.protobuf.xplane_pb2`` and normalizes them into
+plain-python facts the analytics layer (:mod:`dtf_tpu.telemetry.profile`)
+consumes:
+
+- :class:`OpEvent` — one per-op execution slice: the instruction name XLA
+  stamped into the event's ``hlo_op`` stat (``all-reduce.2``, ``dot.3``,
+  ``fusion.7``) plus start/duration in picoseconds. On TPU these live on
+  the ``/device:TPU:N`` planes; on the CPU sim they appear on the host
+  plane when the backend runs with ``--xla_cpu_enable_xprof_traceme=true``
+  (:data:`CPU_OP_TRACE_FLAG` — the capture scripts and tests add it).
+- :class:`StepWindow` — one per ``jax.profiler.StepTraceAnnotation``
+  (the trainer wraps every iteration; ``step_num`` rides as a stat), the
+  time fence that assigns op slices to steps.
+
+Deliberate constraints: NO module-level ``jax``/``tensorflow`` import —
+parsing must work in a process with no backend at all (the srclint
+lazy-import fence covers this file), and every loader degrades to an
+explanatory value instead of raising when TF or the trace files are
+absent (``python -m dtf_tpu.telemetry report`` must print its one JSON
+line whatever the environment looks like).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+from typing import Iterator, Optional
+
+#: XLA:CPU flag that makes the CPU backend emit per-op TraceMe events
+#: (instruction-named, ``hlo_op``-stat-carrying) — without it a CPU trace
+#: has host/python lines only and the parser degrades to step windows.
+CPU_OP_TRACE_FLAG = "--xla_cpu_enable_xprof_traceme=true"
+
+#: stat keys resolved off each event (refs resolved to their string names).
+_OP_STAT = "hlo_op"
+_CATEGORY_STAT = "hlo_category"
+_MODULE_STAT = "hlo_module"
+_STEP_STAT = "step_num"
+
+
+@dataclasses.dataclass(frozen=True)
+class OpEvent:
+    """One executed-op slice on a device (or host-sim) timeline."""
+
+    name: str           # instruction name: the HLO-text join key
+    plane: str
+    line: str
+    start_ps: int
+    dur_ps: int
+    category: str = ""  # backend's hlo_category stat when present (TPU)
+    module: str = ""
+
+    @property
+    def end_ps(self) -> int:
+        return self.start_ps + self.dur_ps
+
+
+@dataclasses.dataclass(frozen=True)
+class StepWindow:
+    """One StepTraceAnnotation span (host TraceMe with a step_num stat)."""
+
+    step: int
+    name: str
+    start_ps: int
+    end_ps: int
+
+    def contains(self, t_ps: int) -> bool:
+        return self.start_ps <= t_ps < self.end_ps
+
+
+@dataclasses.dataclass
+class TraceData:
+    """Normalized content of one XSpace (plus where it came from)."""
+
+    path: str = ""
+    op_events: list = dataclasses.field(default_factory=list)
+    step_windows: list = dataclasses.field(default_factory=list)
+    device_planes: list = dataclasses.field(default_factory=list)
+    host_planes: list = dataclasses.field(default_factory=list)
+
+
+def xplane_available() -> tuple[bool, str]:
+    """(importable?, reason-when-not) for the xplane proto bindings."""
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: F401
+
+        return True, ""
+    except Exception as e:  # noqa: BLE001 — any import failure = degrade
+        return False, f"xplane_pb2 unavailable: {type(e).__name__}: {e}"
+
+
+def find_trace_dir(logdir: str) -> Optional[str]:
+    """Newest ``plugins/profile/<ts>`` session under ``logdir`` (or the
+    logdir itself when it already IS a session dir), None when absent."""
+    if not logdir or not os.path.isdir(logdir):
+        return None
+    if glob.glob(os.path.join(logdir, "*.xplane.pb")):
+        return logdir
+    sessions = sorted(glob.glob(
+        os.path.join(logdir, "plugins", "profile", "*")))
+    return sessions[-1] if sessions else None
+
+
+def find_xplane_files(logdir: str) -> list[str]:
+    d = find_trace_dir(logdir)
+    return sorted(glob.glob(os.path.join(d, "*.xplane.pb"))) if d else []
+
+
+def load_xspace(path: str):
+    """Parse one serialized XSpace; None when the bindings are missing."""
+    ok, _ = xplane_available()
+    if not ok:
+        return None
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        space.ParseFromString(f.read())
+    return space
+
+
+def _resolved_stats(plane, event) -> dict:
+    """Event stats with ref_values resolved to their metadata names."""
+    out = {}
+    for s in event.stats:
+        meta = plane.stat_metadata.get(s.metadata_id)
+        if meta is None:
+            continue
+        which = s.WhichOneof("value")
+        if which is None:
+            continue
+        v = getattr(s, which)
+        if which == "ref_value":
+            ref = plane.stat_metadata.get(v)
+            v = ref.name if ref is not None else str(v)
+        out[meta.name] = v
+    return out
+
+
+def _iter_events(space) -> Iterator[tuple]:
+    """(plane, line, event, metadata, line_base_ps) for every event."""
+    for plane in space.planes:
+        for line in plane.lines:
+            base_ps = int(line.timestamp_ns) * 1000
+            for ev in line.events:
+                md = plane.event_metadata.get(ev.metadata_id)
+                if md is None:
+                    continue
+                yield plane, line, ev, md, base_ps
+
+
+def extract(space, *, path: str = "", step_name: str = "train") -> TraceData:
+    """Normalize one XSpace into :class:`TraceData`.
+
+    Op events are recognized by their ``hlo_op`` stat (present on TPU
+    device planes and on CPU xprof-traceme events alike); step windows by
+    a ``step_num`` stat on an event whose metadata name equals
+    ``step_name`` (the :func:`dtf_tpu.telemetry.spans.step_annotation`
+    default). Planes are split device/host by the ``/device:`` name
+    prefix so the analytics layer can pick per-device semantics when the
+    backend offers them.
+    """
+    data = TraceData(path=path)
+    seen_planes: dict[str, bool] = {}
+    for plane, line, ev, md, base_ps in _iter_events(space):
+        if plane.name not in seen_planes:
+            seen_planes[plane.name] = plane.name.startswith("/device:")
+        stats = _resolved_stats(plane, ev)
+        start = base_ps + int(ev.offset_ps)
+        if _OP_STAT in stats:
+            data.op_events.append(OpEvent(
+                name=str(stats[_OP_STAT]), plane=plane.name,
+                line=line.name, start_ps=start, dur_ps=int(ev.duration_ps),
+                category=str(stats.get(_CATEGORY_STAT, "")),
+                module=str(stats.get(_MODULE_STAT, ""))))
+        elif md.name == step_name and _STEP_STAT in stats:
+            data.step_windows.append(StepWindow(
+                step=int(stats[_STEP_STAT]), name=md.name,
+                start_ps=start, end_ps=start + int(ev.duration_ps)))
+    data.device_planes = sorted(p for p, d in seen_planes.items() if d)
+    data.host_planes = sorted(p for p, d in seen_planes.items() if not d)
+    data.step_windows.sort(key=lambda w: w.start_ps)
+    data.op_events.sort(key=lambda e: e.start_ps)
+    return data
+
+
+def load_trace(logdir: str, *, step_name: str = "train"
+               ) -> tuple[Optional[TraceData], str]:
+    """Load + merge every host's XSpace of the newest session under
+    ``logdir``. Returns ``(TraceData, "")`` or ``(None, reason)`` — the
+    tolerant no-TF / no-trace degradation path."""
+    ok, reason = xplane_available()
+    if not ok:
+        return None, reason
+    files = find_xplane_files(logdir)
+    if not files:
+        return None, f"no *.xplane.pb under {logdir!r}"
+    merged = TraceData(path=find_trace_dir(logdir) or logdir)
+    for f in files:
+        try:
+            space = load_xspace(f)
+        except Exception as e:  # noqa: BLE001 — a truncated pb must not
+            return None, f"unparseable {f!r}: {e}"   # crash the report
+        if space is None:
+            return None, "xplane bindings vanished mid-load"
+        part = extract(space, path=f, step_name=step_name)
+        merged.op_events += part.op_events
+        merged.step_windows += part.step_windows
+        merged.device_planes = sorted(
+            set(merged.device_planes) | set(part.device_planes))
+        merged.host_planes = sorted(
+            set(merged.host_planes) | set(part.host_planes))
+    merged.step_windows.sort(key=lambda w: w.start_ps)
+    merged.op_events.sort(key=lambda e: e.start_ps)
+    return merged, ""

@@ -14,6 +14,16 @@ NEVER imports jax, the child runs under the watchdog behind a probe-first
 budget, and the artifact is always written (a report row or a structured
 error). CPU-sim runs work any round (tiny config; logic check) — pass
 DTF_TEL_TINY=1 or just run without a chip and let the probe route it.
+
+MFU REGRESSION FENCE (ROADMAP item 3 — hold the line once won): a tpu
+row whose ``mfu`` falls more than ``--mfu-tol`` (rel., default 10%)
+below the newest committed TELEMETRY.json row of the SAME config fails
+CLOSED — exit 1, the regressed row is NOT merged, the committed artifact
+keeps the golden. An intentional change rides
+``--allow-mfu-regression="<why>"`` (the comms-budget --write-golden
+idiom): the new row merges with the justification recorded and becomes
+the next baseline. CPU-sim rows are never fenced — sim MFU is a logic
+check, not a measurement.
 """
 
 import json
@@ -24,10 +34,52 @@ import time
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
+from _dtf_artifact import load_runs, merge_runs, same_config as _same
+
 ARTIFACT = os.path.join(ROOT, "TELEMETRY.json")
 SENTINEL = "TELEMETRY_REPORT "
 CHILD_TIMEOUT_S = 900
 TOTAL_BUDGET_S = float(os.environ.get("DTF_TEL_BUDGET_S", "1200"))
+MFU_TOL_DEFAULT = float(os.environ.get("DTF_TEL_MFU_TOL", "0.10"))
+
+#: the identity of a telemetry row for fence purposes — rows measured
+#: under different shapes/models/backends are never comparable.
+CONFIG_KEYS = ("backend", "model", "tiny", "batch", "seq")
+
+
+def same_config(a, b) -> bool:
+    return _same(a, b, CONFIG_KEYS)
+
+
+def fence_baseline(prev_runs, report):
+    """Newest committed row comparable to ``report`` that carries a
+    measured mfu (error rows and mfu-less rows can't be baselines)."""
+    for row in reversed(prev_runs or []):
+        if ("error" not in row and row.get("mfu") is not None
+                and same_config(row, report)):
+            return row
+    return None
+
+
+def check_mfu_fence(prev_runs, report, *, tol_frac=MFU_TOL_DEFAULT):
+    """``(ok, detail)`` — ok=False means a tpu row regressed beyond
+    tolerance vs its committed baseline (the fail-closed case). CPU rows
+    and first-of-config rows pass with an explanatory detail."""
+    backend = report.get("backend")
+    if backend in (None, "cpu"):
+        return True, {"fenced": False, "reason": "cpu-sim row (logic "
+                                                 "check, never fenced)"}
+    if "error" in report or report.get("mfu") is None:
+        return True, {"fenced": False, "reason": "no measured mfu in row"}
+    base = fence_baseline(prev_runs, report)
+    if base is None:
+        return True, {"fenced": False,
+                      "reason": "no committed baseline for this config"}
+    floor = base["mfu"] * (1.0 - tol_frac)
+    detail = {"fenced": True, "baseline_mfu": base["mfu"],
+              "baseline_ts": base.get("ts"), "mfu": report["mfu"],
+              "floor": round(floor, 8), "tol_frac": tol_frac}
+    return report["mfu"] >= floor, detail
 
 
 def child():
@@ -83,26 +135,25 @@ def child():
     print(SENTINEL + json.dumps(report))
 
 
-def _merge(path, entry, meta, keep_runs=20):
-    """telemetry.run.merge_artifact, replicated: importing anything under
-    dtf_tpu pulls _jax_compat → jax, which this parent must never do."""
-    data = {"runs": []}
-    try:
-        with open(path) as f:
-            prev = json.load(f)
-        if isinstance(prev, dict) and isinstance(prev.get("runs"), list):
-            data = prev
-    except (OSError, ValueError):
-        pass
-    data["runs"] = (data["runs"] + [{**entry, **meta}])[-keep_runs:]
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+def _parse_args(argv):
+    """--mfu-tol=X and --allow-mfu-regression="why" (no argparse: the
+    --child re-invocation must pass through untouched)."""
+    tol, justification = MFU_TOL_DEFAULT, None
+    for a in argv:
+        if a.startswith("--mfu-tol="):
+            tol = float(a.split("=", 1)[1])
+        elif a.startswith("--allow-mfu-regression="):
+            justification = a.split("=", 1)[1]
+        elif a == "--allow-mfu-regression":
+            justification = "(no reason given)"
+    return tol, justification
 
 
-def main():
+def main(argv=()):
     from _dtf_watchdog import Budget, child_argv, probe_backend, \
         run_watchdogged
 
+    tol, justification = _parse_args(argv)
     budget = Budget(TOTAL_BUDGET_S)
     meta = {"ts": round(time.time(), 1),
             "round": os.environ.get("DTF_ROUND", "")}
@@ -110,7 +161,7 @@ def main():
         timeout_s=min(90, max(10.0, budget.remaining(10))),
         retries=2, backoff_s=10, env=dict(os.environ))
     if backend is None:
-        _merge(ARTIFACT, {
+        merge_runs(ARTIFACT, {
             "telemetry": "run_report_error",
             "error": ("backend unavailable (probe failed): "
                       + "; ".join(errs))[:2000]}, meta)
@@ -133,10 +184,26 @@ def main():
         report = {"telemetry": "run_report_error",
                   "error": (f"probe OK (backend={backend}) but telemetry "
                             "run failed: " + "; ".join(errors))[:2000]}
-    _merge(ARTIFACT, report, meta)
+
+    # ---- MFU regression fence (vs the COMMITTED artifact, pre-merge) ----
+    ok, fence = check_mfu_fence(load_runs(ARTIFACT), report, tol_frac=tol)
+    if not ok and justification is None:
+        # fail CLOSED: the regressed row does NOT replace the committed
+        # baseline — rerun with --allow-mfu-regression="why" if intended
+        print(json.dumps({"ok": False, "backend": backend,
+                          "mfu": report.get("mfu"), "mfu_fence": fence,
+                          "error": "mfu regression vs committed "
+                                   "TELEMETRY.json row (row not merged; "
+                                   "justify with --allow-mfu-regression)"}))
+        return 1
+    if not ok:
+        report = {**report, "mfu_justification": justification}
+        fence = {**fence, "justified": justification}
+    merge_runs(ARTIFACT, report, meta)
     print(json.dumps({"ok": "error" not in report,
                       "backend": backend,
                       "mfu": report.get("mfu"),
+                      "mfu_fence": fence,
                       "goodput": report.get("goodput_buckets",
                                             {}).get("goodput")}))
     return 0
@@ -146,4 +213,4 @@ if __name__ == "__main__":
     if "--child" in sys.argv:
         child()
     else:
-        sys.exit(main())
+        sys.exit(main(sys.argv[1:]))

@@ -92,6 +92,18 @@ flags.DEFINE_boolean("telemetry", False, "per-engine-call phase spans "
                      "(serve_prefill_chunk / serve_decode p50/p99 in the "
                      "JSON line) and a compile-event fence over the serve "
                      "loop (docs/OBSERVABILITY.md)")
+flags.DEFINE_integer("stats_every", 0, "liveness heartbeat: every N "
+                     "scheduler ticks, emit one JSON snapshot line of "
+                     "router/scheduler stats() to stderr (per-replica "
+                     "occupancy, TTFT p50/p99, ttft_slo_ok_frac); 0 = off")
+flags.DEFINE_float("ttft_slo_frac", 0.0, "with --stats_every and "
+                   "--ttft_slo: log a WARNING when the TTFT SLO-ok "
+                   "fraction drops below this floor (once per excursion)")
+flags.DEFINE_string("trace_out", "", "write a Perfetto-loadable "
+                    "chrome-trace JSON of per-request lifecycles (queue "
+                    "wait, prefill chunks, decode steps, all tagged with "
+                    "end-to-end trace ids) to this path; implies the "
+                    "request TraceCollector is on")
 FLAGS = flags.FLAGS
 
 
@@ -174,13 +186,19 @@ def main(argv):
     except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
         raise app.UsageError(str(e))
     tel = None
-    if FLAGS.telemetry:
-        from dtf_tpu.telemetry import Telemetry
+    if FLAGS.telemetry or FLAGS.trace_out:
+        from dtf_tpu.telemetry import Telemetry, TraceCollector
 
         # serving has its own stall story (the scheduler loop is
         # host-driven); spans + the compile fence are what telemetry
-        # adds here, so no watchdog thread
-        tel = Telemetry(watchdog=False)
+        # adds here, so no watchdog thread. Postmortems go next to the
+        # checkpoint's logdir so the serve flight record is findable.
+        tel = Telemetry(watchdog=False,
+                        out_dir=os.path.join(FLAGS.logdir, "telemetry"))
+        if FLAGS.trace_out:
+            tel.tracer = TraceCollector()
+            for e in engines:
+                e.annotate_traces = True
         tel.start()
     writer = MetricWriter(None, also_log=False)
     if FLAGS.replicas > 1:
@@ -194,6 +212,14 @@ def main(argv):
             engines[0], writer, log_every=0,
             prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
             telemetry=tel, ttft_slo_s=FLAGS.ttft_slo)
+
+    heartbeat = None
+    if FLAGS.stats_every:
+        from dtf_tpu.serve import Heartbeat
+
+        heartbeat = Heartbeat(sched, every_ticks=FLAGS.stats_every,
+                              slo_floor=FLAGS.ttft_slo_frac)
+    on_tick = heartbeat.maybe_emit if heartbeat is not None else None
 
     eos = FLAGS.eos_id if FLAGS.eos_id >= 0 else None
     t0 = time.perf_counter()
@@ -214,7 +240,7 @@ def main(argv):
                     seed=FLAGS.seed + i)))
             except ValueError as e:   # over-long prompt / bad n_new
                 raise app.UsageError(f"request {i}: {e}")
-        sched.run_until_idle()
+        sched.run_until_idle(on_tick=on_tick)
     else:
         prompt_cap = min(FLAGS.prompt_max, FLAGS.max_len - FLAGS.new_min)
         if prompt_cap < FLAGS.prompt_min:
@@ -232,7 +258,7 @@ def main(argv):
                 top_p=FLAGS.top_p, eos_id=eos, seed=FLAGS.seed)
         except ValueError as e:  # rate/prompt/new bound flag errors
             raise app.UsageError(str(e))
-        replay(sched, gen.arrivals())
+        replay(sched, gen.arrivals(), on_tick=on_tick)
         rids = list(range(FLAGS.n_requests))   # submit order = id order
     wall = time.perf_counter() - t0
 
@@ -255,7 +281,18 @@ def main(argv):
            "cache_mib": round(cache_bytes / 2 ** 20, 2)}
     out.update({k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in sched.stats().items()})
+    if heartbeat is not None:
+        out["heartbeats"] = heartbeat.emitted
     if tel is not None:
+        if FLAGS.trace_out and tel.tracer is not None:
+            from dtf_tpu.telemetry.profile import export_chrome_trace
+
+            export_chrome_trace(FLAGS.trace_out,
+                                request_events=tel.tracer.events,
+                                meta={"source": "serve_gpt",
+                                      "replicas": FLAGS.replicas})
+            out["trace_out"] = FLAGS.trace_out
+            out["trace_events"] = len(tel.tracer.events)
         tel.stop()
         out["trace_counts"] = [
             {**e.trace_counts,

@@ -27,6 +27,7 @@ python scripts/bench_attention.py tpu --sweep-blocks-bwd
 python scripts/bench_decode.py
 python scripts/bench_decode.py --sweep-serve
 python scripts/bench_telemetry.py
+python scripts/bench_profile.py
 python scripts/bench_cost_table.py
 python bench.py
 python scripts/bench_lm.py --phases-gpt

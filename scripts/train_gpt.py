@@ -359,7 +359,8 @@ def main(argv):
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
-               *profiler_hooks(FLAGS)],
+               *profiler_hooks(FLAGS, telemetry=tel,
+                               flops_per_step=model_flops)],
         checkpointer=ckpt,
         place_batch=place_batch,
         telemetry=tel)

@@ -106,7 +106,7 @@ def main(argv):
                PreemptionHook(ckpt),
                *([eval_hook] if eval_hook else []),
                StopAtStepHook(FLAGS.train_steps),
-               *profiler_hooks(FLAGS)],
+               *profiler_hooks(FLAGS, telemetry=tel)],
         checkpointer=ckpt,
         telemetry=tel)
     state = trainer.fit(state, iter(data))

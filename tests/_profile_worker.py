@@ -1,0 +1,69 @@
+"""Subprocess worker: ProfilerHook window over the GPT train step on the
+8-device CPU sim, capture→parse round trip (tests/test_profile.py drives
+this under cpu_sim_env + the CPU xprof-traceme flag).
+
+Prints one ``PROFILE_WORKER <json>`` line: the hook's parsed
+device-profile report plus the trainer's trace counts.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.mesh import make_mesh
+    from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.hooks import ProfilerHook, StopAtStepHook
+    from dtf_tpu.loop import Trainer
+    from dtf_tpu.models import gpt
+    from dtf_tpu.telemetry import Telemetry
+
+    logdir = sys.argv[1]
+    cfg = gpt.GPTConfig.tiny()
+    b, s = 8, 64
+    mesh = make_mesh()
+    tel = Telemetry(watchdog=False, n_devices=mesh.devices.size)
+    model, init_fn = gpt.make_init(cfg, mesh, seq_len=s)
+    tx = optax.adamw(1e-4)
+    state, shardings = tr.create_train_state(
+        init_fn, tx, jax.random.PRNGKey(0), mesh, param_rules=gpt.tp_rules)
+    step = tr.make_train_step(gpt.make_loss(model), tx, mesh, shardings,
+                              telemetry=tel)
+    # a TWIN step (no trace counter) supplies the optimized-HLO text for
+    # the provenance join without touching the live program's fence
+    twin = tr.make_train_step(gpt.make_loss(model), tx, mesh, shardings)
+    data = SyntheticData("gpt", b, seed=0, seq_len=s,
+                         vocab_size=cfg.vocab_size)
+
+    def hlo_text():
+        from dtf_tpu.core.comms import shard_batch
+
+        return twin.lower(state0, shard_batch(data.batch(0),
+                                              mesh)).compile().as_text()
+
+    state0 = state
+    # the annotations that straddle the window's open/close TraceMes are
+    # dropped by the profiler; a 5-step window keeps >= 3 full interior
+    # step annotations for the parser
+    hook = ProfilerHook(logdir, start_step=2, num_steps=5,
+                        hlo_text_fn=hlo_text, telemetry=tel,
+                        flops_per_step=1e9)
+    trainer = Trainer(step, mesh,
+                      hooks=[hook, StopAtStepHook(9)], telemetry=tel)
+    trainer.fit(state, iter(data))
+    out = {"profile": hook.last_profile,
+           "trace_counts": trainer.trace_counts,
+           "run_report_has_device_profile":
+               "device_profile" in tel.report()}
+    print("PROFILE_WORKER " + json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -582,6 +582,92 @@ def test_srclint_fences_direct_collectives_in_models(tmp_path):
     assert not probs, probs
 
 
+def test_srclint_fences_backend_imports_in_telemetry(tmp_path):
+    """ISSUE 8 satellite: dtf_tpu/telemetry/ must import without a
+    backend — module-level jax/tensorflow imports there are findings
+    (the loop.py lazy-import idiom); lazy in-function imports and an
+    explicit noqa are the sanctioned spellings. The shipping telemetry
+    package itself must be clean under the rule."""
+    from dtf_tpu.analysis import srclint
+
+    tdir = tmp_path / "dtf_tpu" / "telemetry"
+    tdir.mkdir(parents=True)
+    bad = tdir / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "from tensorflow.tsl.profiler.protobuf import xplane_pb2\n\n"
+        "def f():\n"
+        "    return jax.devices(), xplane_pb2\n")
+    probs = srclint.lint_file(str(bad))
+    assert sum("without a backend" in p for p in probs) == 2, probs
+
+    wrapped = tdir / "wrapped.py"   # try-wrapping still runs on import
+    wrapped.write_text(
+        "try:\n"
+        "    import tensorflow\n"
+        "except ImportError:\n"
+        "    tensorflow = None\n"
+        "if True:\n"
+        "    import jax\n"
+        "X = (jax, tensorflow)\n")
+    probs = srclint.lint_file(str(wrapped))
+    assert sum("without a backend" in p for p in probs) == 2, probs
+
+    ok = tdir / "ok.py"   # lazy import + noqa'd module import both pass
+    ok.write_text(
+        "import jaxtyping_not_a_backend as jt  # unrelated root\n\n"
+        "def f():\n"
+        "    import jax\n\n"
+        "    return jax.devices(), jt\n")
+    assert not srclint.lint_file(str(ok))
+    noqa = tdir / "noqa.py"
+    noqa.write_text("import jax  # noqa: deliberate\nX = jax\n")
+    assert not srclint.lint_file(str(noqa))
+
+    outside = tmp_path / "dtf_tpu" / "other.py"   # rule scoped to telemetry/
+    outside.write_text("import jax\nY = jax\n")
+    assert not srclint.lint_file(str(outside))
+
+    # the shipping telemetry package stays clean — xplane/profile/trace
+    # parse traces on chipless machines and must keep importing that way
+    tel_dir = os.path.join(ROOT, "dtf_tpu", "telemetry")
+    probs = []
+    for f in sorted(os.listdir(tel_dir)):
+        if f.endswith(".py"):
+            probs += [p for p in srclint.lint_file(
+                os.path.join(tel_dir, f)) if "without a backend" in p]
+    assert not probs, probs
+
+
+def test_telemetry_package_imports_without_jax_or_tf(
+        tmp_path, cpu_sim_subprocess_env):
+    """The dynamic twin of the srclint fence: the parser modules import
+    (and tolerantly degrade) in a child whose jax/tensorflow imports are
+    POISONED — the report path must work on a machine with no backend."""
+    import subprocess
+    import sys as _sys
+
+    poison = tmp_path / "poison"
+    for mod in ("jax", "tensorflow", "jaxlib"):
+        d = poison / mod
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text(
+            "raise ImportError('no backend on this machine')\n")
+    env = dict(cpu_sim_subprocess_env)
+    env["PYTHONPATH"] = f"{poison}{os.pathsep}{ROOT}"
+    code = (
+        "from dtf_tpu.telemetry import xplane, profile, trace\n"
+        "ok, reason = xplane.xplane_available()\n"
+        "assert not ok and 'xplane_pb2' in reason, (ok, reason)\n"
+        "rep = profile.parse_logdir('/nonexistent')\n"
+        "assert 'degraded' in rep, rep\n"
+        "print('NO_BACKEND_OK')\n")
+    proc = subprocess.run([_sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(tmp_path))
+    assert "NO_BACKEND_OK" in proc.stdout, (proc.stdout, proc.stderr)
+
+
 def test_srclint_fences_raw_ppermute_perms(tmp_path):
     """ISSUE 7 satellite: a ppermute perm outside core/comms.py /
     ops/collective_matmul.py must be a name bound from

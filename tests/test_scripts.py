@@ -380,6 +380,52 @@ def test_serve_launcher_round_trip(tmp_path):
     assert proc.returncode != 0 and "does not divide" in proc.stderr
 
 
+def test_serve_heartbeat_and_request_trace(tmp_path):
+    """ISSUE 8 satellites through the launcher: --stats_every emits
+    periodic heartbeat JSON lines (stderr; stdout's last line stays the
+    one metrics line), --ttft_slo_frac warns on SLO breach, and
+    --trace_out writes the Perfetto chrome trace with per-request
+    lifecycles tagged by end-to-end trace ids."""
+    import json
+
+    out = _run("train_gpt.py", "--size=tiny", "--train_steps=2",
+               "--batch_size=16", "--seq_len=32", "--checkpoint_every=2",
+               f"--logdir={tmp_path}")
+    assert "done: step=2" in out
+
+    trace_path = tmp_path / "serve_trace.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "serve_gpt.py"),
+         f"--logdir={tmp_path}", "--replicas=2", "--n_slots=2",
+         "--max_len=48", "--prefill_chunk=4", "--poisson_rate=500",
+         "--n_requests=6", "--prompt_min=2", "--prompt_max=10",
+         "--new_min=2", "--new_max=8", "--telemetry", "--stats_every=2",
+         "--ttft_slo=1e-9", "--ttft_slo_frac=0.99",
+         f"--trace_out={trace_path}"],
+        env=_env(), capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    stats = json.loads([ln for ln in proc.stdout.splitlines()
+                        if ln.startswith("{")][-1])
+    assert stats["router_completed"] == 6.0
+    # heartbeats: periodic JSON snapshot lines on stderr, counted in the
+    # final metrics line; the per-replica occupancy/TTFT panel rides them
+    beats = [json.loads(ln) for ln in proc.stderr.splitlines()
+             if ln.startswith('{"serve_heartbeat"')]
+    assert beats and stats["heartbeats"] == len(beats)
+    assert "router_occupancy" in beats[-1]
+    assert any(k.startswith("replica0_") for k in beats[-1])
+    # an impossible SLO (1 ns) must trip the floor warning
+    assert "below the 0.990 floor" in proc.stderr
+    # the chrome trace: request lifecycles with router-global trace ids
+    doc = json.loads(trace_path.read_text())
+    reqs = [e for e in doc["traceEvents"] if e["name"] == "request"]
+    assert len(reqs) == 6
+    assert {e["tid"] for e in reqs} == set(range(6))
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"queue_wait", "serve_prefill_chunk", "serve_decode"} <= names
+    assert stats["trace_events"] == len(doc["traceEvents"])
+
+
 def test_generate_rejects_sampling_flags_at_greedy(tmp_path):
     proc = subprocess.run(
         [sys.executable, os.path.join(ROOT, "scripts", "generate_gpt.py"),

@@ -1,0 +1,144 @@
+"""Hand-built synthetic XPlane proto — the deterministic parser fixture.
+
+Models the raw shape a TPU trace presents: two ``/device:TPU:N`` planes
+whose "XLA Ops" lines carry instruction-named op events with ``hlo_op``
+stats, plus a host plane with the ``train`` StepTraceAnnotation windows.
+All timings are hand-chosen so bucketing, the provenance join, and the
+overlap math have exact expected values (tests/test_profile.py asserts
+them); ``build_bytes()`` serializes with ``deterministic=True`` so the
+output is byte-identical across runs and matches the committed
+``tests/data/xplane_synthetic.pb`` (regenerate by running this module:
+``python tests/xplane_fixture.py``).
+
+The per-device timeline, per step (all offsets in µs from step start,
+step length 10 µs, steps at 0 and 10):
+
+    dot.1              [0, 3)   matmul
+    flash_fwd_pallas   [3, 5)   pallas custom call
+    collective-permute.2 [4, 6) the ring: 1 of its 2 µs hidden under the
+                                pallas kernel → hidden_frac 0.5
+    all-reduce.1       [7, 9)   fully exposed → hidden_frac 0.0
+
+The matching fake optimized-HLO text (``HLO_TEXT``) gives the two
+collectives source metadata, so the provenance join must attribute the
+ring to collective_matmul.py:120 and the all-reduce to train.py:396.
+"""
+
+from __future__ import annotations
+
+import os
+
+US = 1_000_000  # picoseconds per microsecond
+
+#: (name, start_us, dur_us) of one step's device ops; repeated per step.
+STEP_OPS = (
+    ("dot.1", 0, 3),
+    ("flash_fwd_pallas", 3, 2),
+    ("collective-permute.2", 4, 2),
+    ("all-reduce.1", 7, 2),
+)
+STEP_US = 10
+N_STEPS = 2
+DEVICE_PLANES = ("/device:TPU:0", "/device:TPU:1")
+
+HLO_TEXT = """\
+HloModule jit_train_step
+
+ENTRY %main {
+  %dot.1 = f32[64,64]{1,0} dot(f32[64,32]{1,0} %p0, f32[32,64]{1,0} %p1), metadata={op_name="jit(step)/dot_general" source_file="/ws/repo/dtf_tpu/models/gpt.py" source_line=210}
+  %collective-permute.2 = f32[64,64]{1,0} collective-permute(f32[64,64]{1,0} %dot.1), channel_id=1, metadata={op_name="jit(step)/ppermute" source_file="/ws/repo/dtf_tpu/ops/collective_matmul.py" source_line=120}
+  %all-reduce.1 = f32[64,64]{1,0} all-reduce(f32[64,64]{1,0} %collective-permute.2), channel_id=2, to_apply=%add, metadata={op_name="jit(step)/psum" source_file="/ws/repo/dtf_tpu/core/train.py" source_line=396}
+  ROOT %r = f32[] reduce(f32[64,64]{1,0} %all-reduce.1, f32[] %c)
+}
+"""
+
+
+def build_xspace():
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    space = xplane_pb2.XSpace()
+
+    def add_plane(name):
+        plane = space.planes.add()
+        plane.name = name
+        return plane
+
+    def stat_id(plane, name, ids):
+        if name not in ids:
+            sid = len(ids) + 1
+            plane.stat_metadata[sid].id = sid
+            plane.stat_metadata[sid].name = name
+            ids[name] = sid
+        return ids[name]
+
+    def ref_id(plane, value, ids):
+        # ref stats point at ANOTHER stat_metadata entry whose name IS
+        # the value (how XLA interns hlo_op strings)
+        return stat_id(plane, value, ids)
+
+    def event_meta(plane, name, ids):
+        if name not in ids:
+            mid = len(ids) + 1
+            plane.event_metadata[mid].id = mid
+            plane.event_metadata[mid].name = name
+            ids[name] = mid
+        return ids[name]
+
+    # ---- device planes: per-op events ----------------------------------
+    for pname in DEVICE_PLANES:
+        plane = add_plane(pname)
+        sids: dict = {}
+        mids: dict = {}
+        line = plane.lines.add()
+        line.id = 1
+        line.name = "XLA Ops"
+        line.timestamp_ns = 0
+        for step in range(N_STEPS):
+            base = step * STEP_US
+            for name, off, dur in STEP_OPS:
+                ev = line.events.add()
+                ev.metadata_id = event_meta(plane, name, mids)
+                ev.offset_ps = (base + off) * US
+                ev.duration_ps = dur * US
+                st = ev.stats.add()
+                st.metadata_id = stat_id(plane, "hlo_op", sids)
+                st.ref_value = ref_id(plane, name, sids)
+                st2 = ev.stats.add()
+                st2.metadata_id = stat_id(plane, "hlo_module", sids)
+                st2.ref_value = ref_id(plane, "jit_train_step", sids)
+
+    # ---- host plane: step windows ---------------------------------------
+    host = add_plane("/host:CPU")
+    sids, mids = {}, {}
+    line = host.lines.add()
+    line.id = 1
+    line.name = "python"
+    line.timestamp_ns = 0
+    for step in range(N_STEPS):
+        ev = line.events.add()
+        ev.metadata_id = event_meta(host, "train", mids)
+        ev.offset_ps = step * STEP_US * US
+        ev.duration_ps = STEP_US * US
+        st = ev.stats.add()
+        st.metadata_id = stat_id(host, "step_num", sids)
+        st.int64_value = step
+    return space
+
+
+def build_bytes() -> bytes:
+    return build_xspace().SerializeToString(deterministic=True)
+
+
+FIXTURE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "data", "xplane_synthetic.pb")
+
+
+def write_fixture(path: str = FIXTURE_PATH) -> str:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(build_bytes())
+    return path
+
+
+if __name__ == "__main__":
+    print(write_fixture())
