@@ -11,9 +11,13 @@ scrubbed child exactly like ``__graft_entry__.dryrun_multichip``.
     python -m dtf_tpu.analysis --configs=bert,gpt    # subset
     python -m dtf_tpu.analysis --passes=specs,jaxpr,collective   # no compile
     python -m dtf_tpu.analysis --write-golden        # regenerate the fence
-    python -m dtf_tpu.analysis --diff                # per-line provenance
-                                                     # delta vs golden (PR
-                                                     # review aid)
+    python -m dtf_tpu.analysis --diff                # per-line provenance +
+                                                     # memory-field delta vs
+                                                     # golden (PR review aid)
+    python -m dtf_tpu.analysis fit --config=gpt_serve --hbm-gb=16
+                                                     # HBM fit planner: max
+                                                     # KV slots (bf16+int8)
+                                                     # / max global batch
 
 Exit status: 0 = no error findings, 1 = findings, 2 = analyzer crashed.
 The non-zero-on-error contract is what makes ``scripts/lint.sh --full``
@@ -51,6 +55,61 @@ def _reexec_if_needed(argv: list[str]) -> None:
     sys.exit(proc.returncode)
 
 
+def _fit_main(argv: list[str]) -> int:
+    """``python -m dtf_tpu.analysis fit`` — the HBM fit planner."""
+    parser = argparse.ArgumentParser(
+        prog="python -m dtf_tpu.analysis fit",
+        description="Invert the static memory model: what fits a chip.")
+    parser.add_argument("--config", required=True,
+                        help="registry config name (serve configs answer "
+                             "max KV slots bf16+int8; train configs max "
+                             "global batch)")
+    parser.add_argument("--hbm-gb", type=float, required=True,
+                        help="per-chip HBM budget in GiB (v5e: 16)")
+    parser.add_argument("--max-len", type=int, default=1024,
+                        help="serve: per-slot cache length (prompt + "
+                             "generated tokens)")
+    parser.add_argument("--kv-page-size", type=int, default=64,
+                        help="serve: prefix-cache page size in tokens")
+    parser.add_argument("--slots", type=int, default=None,
+                        help="serve: fix the slot count and report the "
+                             "page-pool size the remaining HBM buys")
+    parser.add_argument("--opt", default=None,
+                        help="train: optimizer family to price moments "
+                             "for (default: the config's launcher family)")
+    parser.add_argument("--grad-accum", type=int, default=1,
+                        help="train: price a grad_accum f32 accumulator")
+    parser.add_argument("--grad-shard", action="store_true",
+                        help="train: accumulator ZeRO-1-sharded over data")
+    parser.add_argument("--act-scale", type=float, default=None,
+                        help="train: activation-slope multiplier "
+                             "(≈ (L·T·d)_real/(L·T·d)_program) — switches "
+                             "the resident side to the real-scale spec "
+                             "view")
+    args = parser.parse_args(argv)
+
+    from dtf_tpu.analysis import configs as cfgs
+    from dtf_tpu.analysis import memory as memory_pass
+
+    if args.config not in cfgs.BY_NAME:
+        print(json.dumps({"ok": False,
+                          "error": f"unknown config {args.config!r}; have "
+                                   f"{sorted(cfgs.BY_NAME)}"}))
+        return 2
+    try:
+        out = memory_pass.fit(
+            args.config, hbm_gb=args.hbm_gb, max_len=args.max_len,
+            kv_page_size=args.kv_page_size, slots=args.slots, opt=args.opt,
+            grad_accum=args.grad_accum, grad_shard=args.grad_shard,
+            act_scale=args.act_scale)
+    except Exception as e:  # noqa: BLE001 — last line must still be JSON
+        print(json.dumps({"ok": False,
+                          "error": f"{type(e).__name__}: {e}"[:500]}))
+        return 2
+    print(json.dumps({"ok": True, **out}))
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     try:
@@ -66,13 +125,18 @@ def main(argv: list[str] | None = None) -> int:
                                    f"{e}"[:500]}))
         return 2
 
+    if argv and argv[0] == "fit":
+        return _fit_main(argv[1:])
+
     parser = argparse.ArgumentParser(prog="python -m dtf_tpu.analysis")
     parser.add_argument("--configs", default="",
                         help="comma-separated registry names (default all)")
-    parser.add_argument("--passes", default="specs,jaxpr,collective,hlo",
+    parser.add_argument("--passes",
+                        default="specs,jaxpr,collective,hlo,memory",
                         help="comma-separated passes to run")
     parser.add_argument("--write-golden", action="store_true",
-                        help="regenerate STATIC_ANALYSIS.json comms budgets")
+                        help="regenerate STATIC_ANALYSIS.json comms + "
+                             "memory budgets")
     parser.add_argument("--golden", default="",
                         help="override golden path")
     parser.add_argument("--diff", action="store_true",
@@ -130,8 +194,10 @@ def main(argv: list[str] | None = None) -> int:
 
         if args.diff:
             # review aid, not a verdict: compile each config, print the
-            # per-line provenance delta vs golden as plain lines, keep
-            # the one-JSON-last-line contract with a summary object.
+            # per-line provenance delta AND the per-field memory delta vs
+            # golden as plain lines, keep the one-JSON-last-line contract
+            # with a summary object.
+            from dtf_tpu.analysis import memory as memory_pass
             from dtf_tpu.analysis import provenance
 
             diff_counts = {}
@@ -141,6 +207,8 @@ def main(argv: list[str] | None = None) -> int:
                 want = golden.get("budgets", {}).get(c.name, {})
                 lines = provenance.provenance_delta(
                     budget.get("provenance"), want.get("provenance"))
+                lines += memory_pass.memory_delta(
+                    budget.get("memory"), want.get("memory"))
                 diff_counts[c.name] = len(lines)
                 for line in lines:
                     print(f"{c.name}: {line}")
@@ -181,6 +249,14 @@ def main(argv: list[str] | None = None) -> int:
         # accumulator shrink at a glance (docs/ZERO.md).
         out["temp_bytes"] = {
             name: b.get("memory", {}).get("temp_bytes", 0)
+            for name, b in sorted(budgets.items())}
+        # per-config peak-resident estimate (args + outputs + temps +
+        # code − donated aliases) — the number the fit planner budgets
+        # against a chip's HBM.
+        from dtf_tpu.analysis import memory as memory_pass
+
+        out["hbm_peak_bytes"] = {
+            name: memory_pass.hbm_peak_bytes(b.get("memory", {}))
             for name, b in sorted(budgets.items())}
     print(json.dumps(out))
     return 0 if out["ok"] else 1

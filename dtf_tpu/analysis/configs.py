@@ -31,7 +31,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dtf_tpu.core import train as tr
-from dtf_tpu.core.comms import batch_shardings_for
+from dtf_tpu.core.comms import batch_sharding, batch_shardings_for
 from dtf_tpu.core.mesh import MeshConfig, make_mesh
 from dtf_tpu.data.synthetic import SyntheticData
 
@@ -50,6 +50,12 @@ class StepView:
     step: Callable                    # jitted train step (AOT-lowerable)
     state: PyTree                     # abstract TrainState
     batch: PyTree                     # abstract batch
+    #: the in_shardings the builder passed to jit, as ``(state_shardings,
+    #: batch_shardings)`` — the DECLARED layout the memory pass prices the
+    #: resident-state model at and cross-checks against the executable's
+    #: committed shardings (``state-accounting-drift``).  None = each
+    #: abstract leaf carries its own ``.sharding`` (the serve views).
+    arg_shardings: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +69,13 @@ class AnalysisConfig:
     allow_dead: tuple[str, ...] = ()
     #: leaf-path regexes intentionally replicated despite their size.
     replicated_ok: tuple[str, ...] = ()
+    #: the optimizer family this config's LAUNCHER trains with — the fit
+    #: planner prices optimizer moments for it (``fit --opt`` overrides).
+    opt_name: str = "adamw"
+    #: serve configs: a zero-arg callable returning the REAL-scale model
+    #: config for HBM fit planning (``python -m dtf_tpu.analysis fit``) —
+    #: per-slot KV and page-pool bytes are priced from it via eval_shape.
+    fit_serve_cfg: Callable[[], Any] | None = None
 
     def mesh(self, devices=None) -> Mesh:
         return make_mesh(self.mesh_config, devices=devices)
@@ -101,7 +114,8 @@ def _mnist_step(mesh):
     state, shardings = tr.abstract_train_state(
         mnist.make_init(model), tx, _rng(), mesh)
     step = tr.make_train_step(mnist.make_loss(model), tx, mesh, shardings)
-    return StepView(step, state, _abstract_batch("mnist", 32))
+    return StepView(step, state, _abstract_batch("mnist", 32),
+                    arg_shardings=(shardings, batch_sharding(mesh)))
 
 
 def _resnet_spec(variant):
@@ -130,7 +144,8 @@ def _resnet_step(variant, batch):
             resnet.make_init(model, shape), tx, _rng(), mesh)
         step = tr.make_train_step(
             resnet.make_loss(model, weight_decay=1e-4), tx, mesh, shardings)
-        return StepView(step, state, _abstract_batch(variant, batch))
+        return StepView(step, state, _abstract_batch(variant, batch),
+                        arg_shardings=(shardings, batch_sharding(mesh)))
 
     return build
 
@@ -157,7 +172,8 @@ def _bert_step(mesh):
     step = tr.make_train_step(
         bert.make_loss(model), tx, mesh, shardings, grad_accum=2,
         batch_shardings=batch_sh)
-    return StepView(step, state, batch)
+    return StepView(step, state, batch,
+                    arg_shardings=(shardings, batch_sh))
 
 
 def _bert_accum_step(grad_shard):
@@ -183,7 +199,8 @@ def _bert_accum_step(grad_shard):
         step = tr.make_train_step(
             bert.make_loss(model), tx, mesh, shardings, grad_accum=2,
             grad_shard=grad_shard, batch_shardings=batch_sh)
-        return StepView(step, state, batch)
+        return StepView(step, state, batch,
+                        arg_shardings=(shardings, batch_sh))
 
     return build
 
@@ -206,7 +223,8 @@ def _widedeep_step(mesh):
         param_rules=widedeep.rules)
     step = tr.make_train_step(widedeep.make_loss(model), tx, mesh,
                               shardings)
-    return StepView(step, state, _abstract_batch("widedeep", 64))
+    return StepView(step, state, _abstract_batch("widedeep", 64),
+                    arg_shardings=(shardings, batch_sharding(mesh)))
 
 
 def _gpt_cfg(tiny: bool, **kw):
@@ -214,6 +232,16 @@ def _gpt_cfg(tiny: bool, **kw):
 
     return (gpt.GPTConfig.tiny(**kw) if tiny
             else dataclasses.replace(gpt.GPTConfig.gpt2_small(), **kw))
+
+
+def _gpt_real_cfg(**kw):
+    """Zero-arg REAL-scale model-config builder for the serve entries'
+    ``fit_serve_cfg`` hook — the HBM fit planner prices per-slot KV and
+    page-pool bytes from it (eval_shape only, never compiled)."""
+    def build():
+        return _gpt_cfg(False, **kw)
+
+    return build
 
 
 def _gpt_spec(**cfg_kw):
@@ -245,7 +273,10 @@ def _gpt_step(**cfg_kw):
                 batch, mesh, P("data", "seq"))
         step = tr.make_train_step(gpt.make_loss(model), tx, mesh,
                                   shardings, **kw)
-        return StepView(step, state, batch)
+        return StepView(step, state, batch,
+                        arg_shardings=(shardings,
+                                       kw.get("batch_shardings",
+                                              batch_sharding(mesh))))
 
     return build
 
@@ -285,7 +316,8 @@ def _gpt_eval_step(mesh):
     batch_sh = batch_shardings_for(batch, mesh, P("data", "seq"))
     step = tr.make_eval_step(gpt.make_eval(model), mesh, shardings,
                              batch_shardings=batch_sh)
-    return StepView(step, state, batch)
+    return StepView(step, state, batch,
+                    arg_shardings=(shardings, batch_sh))
 
 
 def _gpt_prefill_step(mesh):
@@ -360,7 +392,8 @@ def _gpt_pipe_step(schedule):
         else:
             loss_fn = gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4)
             step = tr.make_train_step(loss_fn, tx, mesh, shardings)
-        return StepView(step, state, batch)
+        return StepView(step, state, batch,
+                        arg_shardings=(shardings, batch_sharding(mesh)))
 
     return build
 
@@ -386,7 +419,8 @@ def _gpt_pipe_tp_step(mesh):
     loss_fn = gpt_pipe_tp.make_pipe_tp_loss(cfg, mesh, n_microbatches=4)
     step = tr.make_train_step(loss_fn, tx, mesh, shardings)
     return StepView(step, state,
-                    _abstract_batch("gpt", 8, seq_len=32, vocab_size=128))
+                    _abstract_batch("gpt", 8, seq_len=32, vocab_size=128),
+                    arg_shardings=(shardings, batch_sharding(mesh)))
 
 
 #: the registry: five BASELINE workloads + the GPT flagship + pipelined
@@ -395,11 +429,14 @@ def _gpt_pipe_tp_step(mesh):
 #: (bf16/int8), serving prefill, the page-cache tick, and the eval step
 #: (ISSUE 7: the fence covers the fleet, not one program shape).
 REGISTRY: tuple[AnalysisConfig, ...] = (
-    AnalysisConfig("mnist", MeshConfig(data=8), _mnist_spec, _mnist_step),
+    AnalysisConfig("mnist", MeshConfig(data=8), _mnist_spec, _mnist_step,
+                   opt_name="sgd"),
     AnalysisConfig("resnet_cifar", MeshConfig(data=8),
-                   _resnet_spec("cifar"), _resnet_step("cifar", 16)),
+                   _resnet_spec("cifar"), _resnet_step("cifar", 16),
+                   opt_name="momentum"),
     AnalysisConfig("resnet_imagenet", MeshConfig(data=8),
-                   _resnet_spec("imagenet"), _resnet_step("imagenet", 8)),
+                   _resnet_spec("imagenet"), _resnet_step("imagenet", 8),
+                   opt_name="momentum"),
     AnalysisConfig("bert", MeshConfig(data=2, seq=2, model=2),
                    _bert_spec, _bert_step),
     AnalysisConfig("bert_accum", MeshConfig(data=4, seq=2),
@@ -407,7 +444,7 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
     AnalysisConfig("bert_grad_shard", MeshConfig(data=4, seq=2),
                    _bert_spec, _bert_accum_step(True)),
     AnalysisConfig("widedeep", MeshConfig(data=4, model=2),
-                   _widedeep_spec, _widedeep_step),
+                   _widedeep_spec, _widedeep_step, opt_name="adam"),
     AnalysisConfig("gpt", MeshConfig(data=2, seq=2, model=2),
                    _gpt_spec(), _gpt_step(),
                    # the shared GPT rulebook carries the MoE expert rule;
@@ -425,12 +462,14 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    _gpt_spec(), _gpt_serve_step,
                    # decode-mode config: the step is the serving engine's
                    # decode_all, not a train step (dtf_tpu/serve).
-                   allow_dead=(r"w_(in|out)$",)),
+                   allow_dead=(r"w_(in|out)$",),
+                   fit_serve_cfg=_gpt_real_cfg()),
     AnalysisConfig("gpt_serve_int8", MeshConfig(data=4, model=2),
                    _gpt_spec(), _gpt_serve_int8_step,
                    # the quantized-KV serving decode graph (same mesh,
                    # same spec view — params don't quantize).
-                   allow_dead=(r"w_(in|out)$",)),
+                   allow_dead=(r"w_(in|out)$",),
+                   fit_serve_cfg=_gpt_real_cfg(kv_cache_dtype="int8")),
     AnalysisConfig("gpt_eval", MeshConfig(data=2, seq=2, model=2),
                    _gpt_spec(), _gpt_eval_step,
                    # the launcher's eval program at the training mesh —
@@ -441,12 +480,14 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    _gpt_spec(), _gpt_prefill_step,
                    # the serving ADMISSION path (prefill_into_slot) at
                    # the gpt_serve mesh — the engine's other AOT program.
-                   allow_dead=(r"w_(in|out)$",)),
+                   allow_dead=(r"w_(in|out)$",),
+                   fit_serve_cfg=_gpt_real_cfg()),
     AnalysisConfig("gpt_pages", MeshConfig(data=4, model=2),
                    _gpt_spec(), _gpt_pages_step,
                    # the prefix-page-cache load/save programs (PR 6) —
                    # one admission tick, fenced like any other program.
-                   allow_dead=(r"w_(in|out)$",)),
+                   allow_dead=(r"w_(in|out)$",),
+                   fit_serve_cfg=_gpt_real_cfg()),
     AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),
                    _gpt_pipe_spec, _gpt_pipe_step("gpipe"),
                    # embed/head ride ZeRO-1 over data, not the pipe axis

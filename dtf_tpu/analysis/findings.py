@@ -19,7 +19,8 @@ class Finding:
     """
 
     config: str      # registry config name ("" = config-independent)
-    pass_name: str   # "specs" | "hlo" | "jaxpr" | "lint"
+    pass_name: str   # "specs" | "jaxpr" | "collective" | "hlo" |
+                     # "memory" | "lint"
     check: str       # kebab-case check id, e.g. "shadowed-rule"
     severity: str    # one of SEVERITIES
     detail: str      # human-readable, one line

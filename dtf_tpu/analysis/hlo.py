@@ -114,20 +114,24 @@ def collective_stats(hlo_text: str) -> dict:
 def comms_budget(compiled) -> dict:
     """Budget dict for one compiled step (``lowered.compile()`` result).
 
-    Besides the per-opcode collective stats, records the step's peak temp
-    allocation (``memory_analysis().temp_size_in_bytes`` — where grad-accum
-    accumulators, activation stashes and collective staging buffers live),
-    so an accumulator-HBM regression (e.g. a ``--grad_shard`` config
-    silently falling back to the replicated f32 accumulator) fails the
-    fence in tier-1 just like an extra all-gather does.
+    Besides the per-opcode collective stats, records the program's full
+    HBM breakdown from ``memory_analysis()`` — argument/output/peak-temp/
+    alias/generated-code bytes (``analysis/memory.MEMORY_FIELDS``), where
+    grad-accum accumulators, activation stashes, collective staging
+    buffers AND the resident state itself live.  The memory pass
+    (:func:`dtf_tpu.analysis.memory.check_memory`) fences every field
+    against the golden, so an accumulator-HBM regression (e.g. a
+    ``--grad_shard`` config silently falling back to the replicated f32
+    accumulator) or a state leaf going replicated fails tier-1 just like
+    an extra all-gather does.
     """
+    from dtf_tpu.analysis import memory as memory_pass
+
     text = compiled.as_text()
     budget = collective_stats(text)
-    try:
-        mem = compiled.memory_analysis()
-        budget["memory"] = {"temp_bytes": int(mem.temp_size_in_bytes)}
-    except Exception:  # noqa: BLE001 — backends without an allocator report
-        pass
+    mem = memory_pass.memory_breakdown(compiled)
+    if mem is not None:
+        budget["memory"] = mem
     # source attribution per collective call site (analysis/provenance.py)
     # — recorded in the golden but never fenced on its own: it names the
     # offending line when the opcode fence above trips, and feeds --diff.
@@ -146,6 +150,10 @@ def check_budget(budget: Mapping[str, Any], golden: Mapping[str, Any],
     (shapes are deterministic for a pinned jax/XLA); regenerate the golden
     via ``python -m dtf_tpu.analysis --write-golden`` when a change is
     intentional, and justify the diff in the PR.
+
+    The budget's ``memory`` breakdown is fenced by the memory pass
+    (:func:`dtf_tpu.analysis.memory.check_memory`), not here — this
+    fence owns the collectives only.
     """
     from dtf_tpu.analysis import provenance
 
@@ -179,26 +187,6 @@ def check_budget(budget: Mapping[str, Any], golden: Mapping[str, Any],
                 config, "hlo", "collective-bytes-drift", "error",
                 f"{op}: {got['bytes']:,} B vs {want['bytes']:,} B golden "
                 f"(count unchanged — shapes/dtypes moved){where}"))
-    want_mem = golden.get("memory")
-    got_mem = budget.get("memory")
-    if want_mem is not None and got_mem is None:
-        # fail CLOSED: a backend that stops reporting memory_analysis()
-        # must not silently disable the accumulator-HBM fence (and a
-        # subsequent --write-golden would silently drop the 'memory'
-        # entries) — surface it as a finding instead.
-        findings.append(Finding(
-            config, "hlo", "temp-bytes-unavailable", "error",
-            "golden pins a peak-temp budget but memory_analysis() "
-            "reported nothing on this backend — the accumulator-HBM "
-            "fence did not run"))
-    elif want_mem is not None and (
-            got_mem["temp_bytes"] != want_mem["temp_bytes"]):
-        findings.append(Finding(
-            config, "hlo", "temp-bytes-drift", "error",
-            f"peak temp allocation {got_mem['temp_bytes']:,} B vs "
-            f"{want_mem['temp_bytes']:,} B golden (accumulators / stashes "
-            f"/ staging buffers moved; regenerate with --write-golden if "
-            f"intended)"))
     return findings
 
 

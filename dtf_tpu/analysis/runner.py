@@ -9,13 +9,15 @@ from dtf_tpu.analysis import collective as collective_pass
 from dtf_tpu.analysis import configs as cfgs
 from dtf_tpu.analysis import hlo as hlo_pass
 from dtf_tpu.analysis import jaxpr as jaxpr_pass
+from dtf_tpu.analysis import memory as memory_pass
 from dtf_tpu.analysis import specs as specs_pass
 from dtf_tpu.analysis.findings import Finding
 
 GOLDEN_BASENAME = "STATIC_ANALYSIS.json"
 
-#: every pass the runner knows, in execution order.
-ALL_PASSES = ("specs", "jaxpr", "collective", "hlo")
+#: every pass the runner knows, in execution order.  "hlo" and "memory"
+#: share one AOT compile per config (compile_program).
+ALL_PASSES = ("specs", "jaxpr", "collective", "hlo", "memory")
 
 
 def golden_path() -> str:
@@ -60,10 +62,22 @@ def run_collective(config: cfgs.AnalysisConfig, view=None) -> list[Finding]:
     return collective_pass.lint_collectives(closed, config=config.name)
 
 
+def compile_program(config: cfgs.AnalysisConfig, view=None):
+    """AOT-compile a config's program once for every compiled-side pass.
+
+    Returns ``(view, lowered, compiled)`` — the hlo pass reads the
+    optimized text, the memory pass additionally needs the lowering's
+    ``args_info`` (donation flags) and the executable's committed input
+    shardings.
+    """
+    view = view or config.step_view(config.mesh())
+    lowered = view.step.lower(view.state, view.batch)
+    return view, lowered, lowered.compile()
+
+
 def compile_budget(config: cfgs.AnalysisConfig, view=None) -> dict:
     """AOT-compile the tiny train step and extract its comms budget."""
-    view = view or config.step_view(config.mesh())
-    compiled = view.step.lower(view.state, view.batch).compile()
+    _, _, compiled = compile_program(config, view)
     return hlo_pass.comms_budget(compiled)
 
 
@@ -79,6 +93,20 @@ def run_hlo(config: cfgs.AnalysisConfig, golden: dict,
     return hlo_pass.check_budget(budget, want, config=config.name)
 
 
+def run_memory(config: cfgs.AnalysisConfig, golden: dict,
+               view=None, lowered=None, compiled=None,
+               budget: dict | None = None) -> list[Finding]:
+    """The memory pass for one config: breakdown fence vs golden +
+    resident-state accounting cross-check + donation soundness/gate.
+    Shares ``compile_program``'s artifacts with the hlo pass when the
+    caller provides them."""
+    if compiled is None:
+        view, lowered, compiled = compile_program(config, view)
+    want = golden.get("budgets", {}).get(config.name)
+    return memory_pass.lint_program(config, view, lowered, compiled,
+                                    want, budget)
+
+
 def analyze(names: Sequence[str] | None = None,
             passes: Sequence[str] = ALL_PASSES,
             golden: dict | None = None,
@@ -91,7 +119,7 @@ def analyze(names: Sequence[str] | None = None,
     """
     selected = (cfgs.REGISTRY if not names
                 else tuple(cfgs.BY_NAME[n] for n in names))
-    if "hlo" in passes and golden is None:
+    if {"hlo", "memory"} & set(passes) and golden is None:
         path = golden_path()
         golden = (hlo_pass.load_golden(path) if os.path.exists(path)
                   else {"budgets": {}})
@@ -105,9 +133,11 @@ def analyze(names: Sequence[str] | None = None,
             findings += run_specs(config)
         # the step view (mesh + full train-step construction) is the
         # expensive part — build it once and share across all trace/
-        # compile passes; jaxpr + collective also share the one trace
+        # compile passes; jaxpr + collective also share the one trace,
+        # hlo + memory the one AOT compile
         view = (config.step_view(config.mesh())
-                if {"jaxpr", "collective", "hlo"} & set(passes) else None)
+                if {"jaxpr", "collective", "hlo", "memory"} & set(passes)
+                else None)
         if {"jaxpr", "collective"} & set(passes):
             closed = jaxpr_pass.trace_step(view.step, view.state,
                                            view.batch)
@@ -117,9 +147,14 @@ def analyze(names: Sequence[str] | None = None,
             if "collective" in passes:
                 findings += collective_pass.lint_collectives(
                     closed, config=config.name)
-        if "hlo" in passes:
-            budget = compile_budget(config, view)
+        if {"hlo", "memory"} & set(passes):
+            view, lowered, compiled = compile_program(config, view)
+            budget = hlo_pass.comms_budget(compiled)
             if budgets_out is not None:
                 budgets_out[config.name] = budget
-            findings += run_hlo(config, golden, view, budget=budget)
+            if "hlo" in passes:
+                findings += run_hlo(config, golden, view, budget=budget)
+            if "memory" in passes:
+                findings += run_memory(config, golden, view, lowered,
+                                       compiled, budget=budget)
     return findings

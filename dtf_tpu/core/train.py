@@ -181,6 +181,20 @@ def abstract_train_state(
     return abstract, shardings
 
 
+def donation_enabled(donate: bool = True) -> bool:
+    """The ONE donation gate: whether train steps may donate their state.
+
+    On backfilled (pre-0.5) jax a DONATED executable deserialized from
+    the persistent compile cache drops aliased outputs (warm-run BN
+    stats freeze — see tests/conftest.py), so donation is version-gated
+    off there.  Exposed as a hook so the static analyzer's memory pass
+    can ASSERT the gate (``donation-on-backfilled-jax``: a registry
+    program donating anything on backfilled jax means this gate was
+    bypassed) instead of assuming a comment still matches the code.
+    """
+    return donate and not _compat.BACKFILLED
+
+
 def make_train_step(
     loss_fn: LossFn,
     tx: optax.GradientTransformation,
@@ -396,11 +410,9 @@ def make_train_step(
         step_fn,
         in_shardings=(shardings, batch_sh),
         out_shardings=(shardings, NamedSharding(mesh, P())),
-        # donation is version-gated: on pre-0.5 jax a DONATED executable
-        # deserialized from the persistent compile cache drops aliased
-        # outputs (warm-run BN stats freeze; see tests/conftest.py note) —
-        # the sim has memory headroom, the real-chip env has new jax.
-        donate_argnums=(0,) if donate and not _compat.BACKFILLED else (),
+        # donation is version-gated through donation_enabled() — the
+        # analyzer's memory pass asserts the gate (see its docstring).
+        donate_argnums=(0,) if donation_enabled(donate) else (),
     )
 
 
@@ -452,11 +464,9 @@ def make_train_step_from_grads(
         step_fn,
         in_shardings=(shardings, batch_sh),
         out_shardings=(shardings, NamedSharding(mesh, P())),
-        # donation is version-gated: on pre-0.5 jax a DONATED executable
-        # deserialized from the persistent compile cache drops aliased
-        # outputs (warm-run BN stats freeze; see tests/conftest.py note) —
-        # the sim has memory headroom, the real-chip env has new jax.
-        donate_argnums=(0,) if donate and not _compat.BACKFILLED else (),
+        # donation is version-gated through donation_enabled() — the
+        # analyzer's memory pass asserts the gate (see its docstring).
+        donate_argnums=(0,) if donation_enabled(donate) else (),
     )
 
 

@@ -687,6 +687,21 @@ class DecodeEngine:
                    for x in leaves)
 
 
+def engine_state_struct(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
+                        mesh: Optional[Mesh] = None) -> PyTree:
+    """Abstract engine state (slot-batched KV cache + per-slot arrays)
+    exactly as a ``DecodeEngine(cfg, n_slots=, max_len=)`` would allocate
+    it — ShapeDtypeStructs with the engine's shardings attached.  The
+    introspection hook the HBM fit planner (``python -m dtf_tpu.analysis
+    fit``) prices per-slot KV bytes from (bf16 vs int8 via
+    ``cfg.kv_cache_dtype``), and the page-pool twin of
+    :func:`dtf_tpu.serve.pages.pool_abstract` — eval_shape only, no
+    device memory, no compile."""
+    dec = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True,
+                              chunked_prefill=False)
+    return _state_struct(dec, n_slots, mesh)
+
+
 def decode_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
                      mesh: Optional[Mesh] = None):
     """The engine's decode program as an analyzable step:

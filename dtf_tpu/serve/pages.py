@@ -36,6 +36,12 @@ Design rules (the fixed-shape discipline of docs/SERVING.md, extended):
 The device half (pool state + the two AOT page programs) lives in
 ``engine.py``; :func:`pool_abstract` here builds the pool's abstract
 struct from the engine's cache struct so the two cannot desynchronize.
+It is also the HBM fit planner's pricing hook (``python -m
+dtf_tpu.analysis fit --config=gpt_serve``): per-page device bytes come
+from ``pool_abstract(cache, 1, page_size, mesh)`` at the REAL model
+config, so the planner's page-pool answer is derived from the exact
+struct the engine allocates (``engine.engine_state_struct`` is the
+per-slot twin).
 """
 
 from __future__ import annotations

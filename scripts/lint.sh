@@ -8,7 +8,10 @@
 #   scripts/lint.sh --analyze   # + the static analyzer's cheap passes
 #                               #   (specs,jaxpr,collective — no compiles)
 #   scripts/lint.sh --full      # + the WHOLE analyzer (all passes incl.
-#                               #   the AOT comms-budget fence) — the
+#                               #   the AOT comms-budget fence AND the
+#                               #   memory pass: HBM breakdown fence,
+#                               #   state-accounting cross-check,
+#                               #   donation soundness) — the
 #                               #   pre-commit gate: exits non-zero on any
 #                               #   error finding. Probe-free: the
 #                               #   analysis CLI re-execs itself into the
@@ -77,7 +80,7 @@ if [ "$ANALYZE" = "1" ]; then
 fi
 
 if [ "$FULL" = "1" ]; then
-  echo "lint: dtf_tpu.analysis (all passes incl. comms-budget fence)"
+  echo "lint: dtf_tpu.analysis (all passes incl. comms + memory fences)"
   # the CLI exits 1 on any error finding and 2 on a crash — srclint above
   # plus this is the whole static gate (docs/ANALYSIS.md)
   python -m dtf_tpu.analysis

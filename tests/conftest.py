@@ -29,6 +29,30 @@ if (not is_cpu_sim(os.environ, 8)
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# The persistent cache's executable loader prints benign `cpu_aot_loader`
+# feature-mismatch warnings on every warm deserialization in some
+# environments (CLAUDE.md).  With the memory pass now fencing every
+# program's HBM breakdown, a real memory-fence failure must not scroll
+# away inside that noise — downgrade exactly this class (pattern-matched
+# on both the warnings and logging spellings; everything else stays
+# loud).
+import logging  # noqa: E402
+import warnings  # noqa: E402
+
+warnings.filterwarnings("ignore", message=r".*cpu_aot_loader.*")
+
+
+class _CpuAotLoaderNoise(logging.Filter):
+    def filter(self, record):  # pragma: no cover — env-dependent noise
+        # scoped to the loader's own messages: a NEW "feature mismatch"
+        # from anywhere else must stay loud
+        return "cpu_aot_loader" not in record.getMessage()
+
+
+for _name in ("jax", "jax._src.compiler", "jax._src.compilation_cache",
+              "absl"):
+    logging.getLogger(_name).addFilter(_CpuAotLoaderNoise())
+
 # Persistent compilation cache: the suite's wall-clock is dominated by
 # recompiling identical 8-device shard_map graphs every run (VERDICT r3
 # weak #5). With the cache, a warm full-pyramid run spends seconds where a
