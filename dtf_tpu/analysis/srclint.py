@@ -28,6 +28,13 @@ imports executed):
   backpressure points carry a ``# blocking-ok: <why>`` marker. This
   protects the invariant statically; tests/test_telemetry.py proves it
   dynamically with the counter-instrumented fit,
+- integer block-shape literals at flash-attention / Pallas fused-CE
+  call sites outside ``dtf_tpu/ops/`` + ``dtf_tpu/tune/`` (and test
+  files, whose parity pins are the point) — launchers and models must
+  leave block args at 0 so the kernel-tune resolver supplies the banked
+  per-shape winner (KERNEL_TUNE.json; docs/TUNING.md). A hard-coded
+  literal silently freezes a shape the autotuner has since beaten —
+  the PR 7 ring-perm fence idiom applied to block shapes,
 - module-level ``jax`` / ``tensorflow`` imports in ``dtf_tpu/telemetry/``
   — the telemetry package (the XPlane parser and report CLI especially)
   must import without ANY backend present: reports are generated on
@@ -147,7 +154,8 @@ def lint_file(path: str) -> list[str]:
     # Without a `dtf_tpu` anchor (fixtures, scratch files) only the
     # immediate parent directory counts.
     dirs = os.path.abspath(path).replace(os.sep, "/").split("/")[:-1]
-    if "dtf_tpu" in dirs:
+    anchored = "dtf_tpu" in dirs
+    if anchored:
         dirs = dirs[len(dirs) - dirs[::-1].index("dtf_tpu"):]
         in_models = "models" in dirs
     else:
@@ -170,8 +178,22 @@ def lint_file(path: str) -> list[str]:
                     f"dtf_tpu.core.comms (the comms-budget fence and "
                     f"--tp_overlap dispatch choke point)")
 
-    # ---- raw ppermute perm lists (must come from the named builders) ----
+    # ---- block-shape literals at tuned-kernel call sites ----
+    # anchored files: dirs is already trimmed past the last `dtf_tpu`
+    # segment (the models/ fence above), so `in` checks are in-package.
+    # Unanchored files (scripts/, tests/, scratch): only the IMMEDIATE
+    # parent counts — a checkout under /home/ci/tests/... must not
+    # exempt every file, nor an ancestor named ops/ bless one (the same
+    # anchoring discipline as the models/ fence).
     base = os.path.basename(path)
+    in_tests = (("tests" in dirs) if anchored
+                else (bool(dirs) and dirs[-1] == "tests")) \
+        or base.startswith("test_")
+    blessed_block_module = bool(dirs) and dirs[-1] in ("ops", "tune")
+    if not (blessed_block_module or in_tests):
+        problems += _block_literals(tree, path, noqa)
+
+    # ---- raw ppermute perm lists (must come from the named builders) ----
     blessed_perm_module = (
         ("dtf_tpu" in dirs or (bool(dirs) and dirs[-1] in ("core", "ops")))
         and ((base == "comms.py" and (not dirs or dirs[-1] == "core"))
@@ -185,12 +207,20 @@ def lint_file(path: str) -> list[str]:
             "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
         problems += _hotpath_readbacks(tree, path, noqa, src)
 
-    # ---- backend imports fenced out of the telemetry package ----
-    in_telemetry = ("telemetry" in dirs
-                    if "dtf_tpu" in dirs
-                    else bool(dirs) and dirs[-1] == "telemetry")
-    if in_telemetry:
-        problems += _backend_imports(tree, path, noqa)
+    # ---- backend imports fenced out of telemetry/ AND tune/ ----
+    # telemetry: reports parse traces on chipless machines. tune: the
+    # bench_tune parent imports the package BEFORE probing the backend
+    # (dead-tunnel rc-0 contract) — a module-level jax import in either
+    # can hang a live-axon process before any code runs.
+    for pkg, why in (("telemetry", "reports parse traces on chipless "
+                      "machines; an axon-env jax import can hang"),
+                     ("tune", "bench_tune's parent imports it BEFORE "
+                      "probing the backend — a module-level backend "
+                      "import hangs the dead-tunnel rc-0 path")):
+        in_pkg = (pkg in dirs if anchored
+                  else bool(dirs) and dirs[-1] == pkg)
+        if in_pkg:
+            problems += _backend_imports(tree, path, noqa, pkg, why)
 
     return problems
 
@@ -200,14 +230,17 @@ def lint_file(path: str) -> list[str]:
 _BACKEND_ROOTS = ("jax", "jaxlib", "tensorflow")
 
 
-def _backend_imports(tree, path: str, noqa: set) -> list:
-    """Import-time backend imports in ``dtf_tpu/telemetry/`` — the
-    package must stay importable (and its parser runnable) in a process
-    with no jax/tensorflow at all, and a module-level jax import in a
-    live axon env can hang before any code runs (CLAUDE.md). Lazy
-    imports inside functions are the sanctioned spelling; anything that
-    executes at module import time is fenced, including imports wrapped
-    in try/if or sitting in a class body (they still run on import)."""
+def _backend_imports(tree, path: str, noqa: set,
+                     pkg: str = "telemetry",
+                     why: str = "reports parse traces on chipless "
+                     "machines; an axon-env jax import can hang") -> list:
+    """Import-time backend imports in a fenced package (``telemetry/``,
+    ``tune/``) — these must stay importable in a process with no
+    jax/tensorflow at all, and a module-level jax import in a live axon
+    env can hang before any code runs (CLAUDE.md). Lazy imports inside
+    functions are the sanctioned spelling; anything that executes at
+    module import time is fenced, including imports wrapped in try/if
+    or sitting in a class body (they still run on import)."""
     def module_time_nodes(body):
         # every statement that executes when the module is imported:
         # descend into try/if/with/class bodies, NOT into functions
@@ -233,11 +266,53 @@ def _backend_imports(tree, path: str, noqa: set) -> list:
             if root in _BACKEND_ROOTS and node.lineno not in noqa:
                 problems.append(
                     f"{path}:{node.lineno}: module-level '{root}' import "
-                    f"in dtf_tpu/telemetry/ — the telemetry package must "
-                    f"import without a backend (reports parse traces on "
-                    f"chipless machines; an axon-env jax import can "
-                    f"hang); import it lazily inside the function that "
-                    f"needs it")
+                    f"in dtf_tpu/{pkg}/ — the {pkg} package must "
+                    f"import without a backend ({why}); import it "
+                    f"lazily inside the function that needs it")
+    return problems
+
+
+#: tuned-kernel entry points and the block kwargs the tuner owns: an
+#: int literal for one of these outside ops//tune/ (and tests) bypasses
+#: the kernel-tune resolver (dtf_tpu/tune; docs/TUNING.md).
+_TUNED_KERNEL_CALLS = {
+    "flash_attention": ("block_q", "block_k", "block_h",
+                        "block_q_bwd", "block_k_bwd"),
+    "flash_attention_sharded": ("block_h",),
+    "pallas_lm_cross_entropy": ("block_n", "block_v"),
+    "pallas_lm_cross_entropy_sharded": ("block_n", "block_v"),
+}
+
+
+def _block_literals(tree, path: str, noqa: set) -> list:
+    """Nonzero int literals for tuner-owned block kwargs at flash /
+    fused-CE call sites — launchers and models must leave them at 0 (the
+    resolver sentinel) or thread a resolved variable, so the banked
+    per-shape winners actually apply. 0 is the sentinel itself and
+    stays legal; a deliberate pin carries ``# noqa`` with its why."""
+    problems = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.lineno not in noqa):
+            continue
+        fn = node.func
+        fn_name = (fn.id if isinstance(fn, ast.Name)
+                   else fn.attr if isinstance(fn, ast.Attribute) else None)
+        fenced = _TUNED_KERNEL_CALLS.get(fn_name or "")
+        if not fenced:
+            continue
+        for kw in node.keywords:
+            if (kw.arg in fenced and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, int)
+                    and not isinstance(kw.value.value, bool)
+                    and kw.value.value != 0
+                    and kw.value.lineno not in noqa):
+                problems.append(
+                    f"{path}:{kw.value.lineno}: block-shape literal "
+                    f"{kw.arg}={kw.value.value} at a {fn_name} call — "
+                    f"leave it 0 so the kernel-tune resolver supplies "
+                    f"the banked winner (dtf_tpu/tune, KERNEL_TUNE.json; "
+                    f"docs/TUNING.md), or mark a deliberate pin with "
+                    f"'# noqa: <why>'")
     return problems
 
 

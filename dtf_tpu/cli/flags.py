@@ -10,6 +10,8 @@ mesh + process identity (:func:`dtf_tpu.core.dist.collapse_cluster_flags`).
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 from absl import flags
 
 FLAGS = flags.FLAGS
@@ -380,25 +382,48 @@ LOGITS_HBM_FRACTION = 0.25
 AUTO_LOSS_CHUNK_TOKENS = 4096
 
 
+class LmLossPath(NamedTuple):
+    """The resolved LM loss path (``resolve_lm_loss``). NamedTuple so
+    launchers destructure the chunk fields positionally where the old
+    2-tuple contract did, with the pallas path and winner provenance
+    riding behind."""
+
+    chunk_vocab: int
+    chunk_tokens: int
+    pallas: bool = False
+    source: str = "heuristic"
+
+
 def resolve_lm_loss(FLAGS, *, batch: int, seq_len: int, vocab_size: int,
                     mesh_shape=None, hbm_bytes: float = HBM_BYTES_PER_CHIP):
-    """Pick the LM loss path from an HBM estimate (PERF.md §0c).
+    """Pick the LM loss path: HBM estimate + the kernel-tune winners.
 
     The vocab-chunked loss is a MEMORY lever, not a speed lever: it costs
     ~9 MFU points on GPT and ~5 on BERT versus the monolithic [B,T,V]
-    matmul+CE that XLA fuses. So: when no fused-loss flag is set and the
-    full logits plus their cotangent fit comfortably per device, keep the
-    monolithic path; when they don't, auto-select the token-chunked fused
-    loss (the faster chunking axis on chip). When an EXPLICIT flag forces
-    a fused path even though the logits fit, warn — the user is paying
-    MFU for memory they don't need — but honor the flag.
+    matmul+CE that XLA fuses (PERF.md §0c). So: when no fused-loss flag
+    is set and the full logits plus their cotangent fit comfortably per
+    device, keep the monolithic path; when they don't, take the banked
+    loss-path winner from the kernel-tune cache
+    (:func:`dtf_tpu.tune.resolver.lm_loss_winner` — seeded from the
+    on-chip BENCH_LM_SWEEP rows, refreshed by ``bench_tune.py``),
+    defaulting to the token-chunked fused CE — one full-vocab MXU
+    matmul per block, the faster chunking axis — never the vocab scan.
 
-    Returns ``(loss_chunk_vocab, loss_chunk_tokens)``; ``--loss_pallas``
-    and the TP/pipe restrictions are handled by the launchers (fused
-    losses don't compose with a sharded head, so under ``mesh_model > 1``
-    or ``mesh_pipe > 1`` this keeps the monolithic path).
+    EXPLICIT flags always win, but warn when they force a
+    measured-slower path: any fused flag on a fitting config (paying
+    ~9 MFU points for memory it doesn't need), and ``--loss_chunk_vocab``
+    on a non-fitting config where the banked winner is a different
+    bounded-memory path.
+
+    Returns :class:`LmLossPath`. TP/pipe restrictions stay here: fused
+    losses don't compose with a vocab-sharded head or the pipelined
+    loss, so under ``mesh_model > 1`` / ``mesh_pipe > 1`` the monolithic
+    path is the only legal one (the launchers additionally reject
+    explicit fused flags there).
     """
     from absl import logging as absl_logging
+
+    from dtf_tpu.tune import resolver as tune_resolver
 
     mesh_shape = mesh_shape or {}
     lchunk = getattr(FLAGS, "loss_chunk_vocab", 0)
@@ -410,30 +435,74 @@ def resolve_lm_loss(FLAGS, *, batch: int, seq_len: int, vocab_size: int,
     # f32 logits + cotangent live simultaneously through the backward
     est = 2 * (batch * seq_len / shards) * vocab_size * 4
     fits = est <= LOGITS_HBM_FRACTION * hbm_bytes
+    n_devices = 1
+    for v in mesh_shape.values():
+        n_devices *= max(int(v), 1)
+    winner = tune_resolver.lm_loss_winner(
+        fits=fits, vocab=vocab_size, seq=seq_len, batch=batch,
+        n_devices=n_devices, backend=None)
     if lchunk or tchunk or lpallas:
+        which = ("--loss_chunk_vocab" if lchunk else
+                 "--loss_chunk_tokens" if tchunk else "--loss_pallas")
         if fits:
-            which = ("--loss_chunk_vocab" if lchunk else
-                     "--loss_chunk_tokens" if tchunk else "--loss_pallas")
             absl_logging.warning(
                 "%s forces a fused LM loss but the monolithic [B,T,V] "
                 "logits fit (est %.2f GB/device of %.0f GB HBM): the "
                 "chunked path costs ~9 GPT MFU points (PERF.md 0c) — "
                 "drop the flag to let the HBM estimate pick", which,
                 est / 1e9, hbm_bytes / 1e9)
-        return lchunk, tchunk
-    if fits:
-        return 0, 0
+        elif lchunk and (winner is None or winner.path != "chunk_vocab"):
+            absl_logging.warning(
+                "--loss_chunk_vocab forces the measured-slower chunking "
+                "axis (the serialized vocab scan costs ~9 GPT MFU "
+                "points, PERF.md 0c); the banked winner here is %s (%s) "
+                "— drop the flag to follow it",
+                winner.path if winner else "the token-chunked fused CE",
+                winner.source if winner else "PERF.md 0b chunk-axis "
+                "ordering")
+        return LmLossPath(lchunk, tchunk, lpallas, source="explicit")
     if (mesh_shape.get("model", 1) > 1 or mesh_shape.get("pipe", 1) > 1):
         # fused losses don't compose with a vocab-sharded head / the
         # pipelined loss; the monolithic path is the only legal one here
-        return 0, 0
+        return LmLossPath(0, 0, source="tp/pipe mesh: monolithic only")
+    if fits:
+        if winner is not None and winner.path != "monolithic":
+            # a measured bounded-memory path BEAT monolithic at a
+            # fitting shape — honor the data over the heuristic
+            return _loss_path_from_winner(winner)
+        return LmLossPath(0, 0, source="monolithic logits fit (est "
+                          f"{est / 1e9:.2f} GB/device)")
+    if winner is not None and winner.path in ("chunk_tokens",
+                                              "chunk_vocab", "pallas"):
+        # a monolithic winner is NOT honored here: the estimate says the
+        # logits don't fit, and a banked mono row from a smaller shape
+        # must not talk a bigger one into an OOM.
+        absl_logging.warning(
+            "monolithic [B,T,V] logits estimated at %.2f GB/device "
+            "(> %d%% of %.0f GB HBM): taking the banked loss-path "
+            "winner %s (%s); pass an explicit fused-loss flag to "
+            "override", est / 1e9, int(LOGITS_HBM_FRACTION * 100),
+            hbm_bytes / 1e9, winner.path, winner.source)
+        return _loss_path_from_winner(winner)
     absl_logging.warning(
         "monolithic [B,T,V] logits estimated at %.2f GB/device (> %d%% of "
         "%.0f GB HBM): auto-selecting the token-chunked fused loss "
         "(chunk=%d); pass --loss_chunk_tokens/--loss_chunk_vocab to "
         "override", est / 1e9, int(LOGITS_HBM_FRACTION * 100),
         hbm_bytes / 1e9, AUTO_LOSS_CHUNK_TOKENS)
-    return 0, AUTO_LOSS_CHUNK_TOKENS
+    return LmLossPath(0, AUTO_LOSS_CHUNK_TOKENS,
+                      source="HBM heuristic (no banked winner)")
+
+
+def _loss_path_from_winner(winner) -> "LmLossPath":
+    if winner.path == "chunk_vocab":
+        return LmLossPath(winner.chunk or 8192, 0, source=winner.source)
+    if winner.path == "chunk_tokens":
+        return LmLossPath(0, winner.chunk or AUTO_LOSS_CHUNK_TOKENS,
+                          source=winner.source)
+    if winner.path == "pallas":
+        return LmLossPath(0, 0, pallas=True, source=winner.source)
+    return LmLossPath(0, 0, source=winner.source)
 
 
 def wrap_optimizer(tx, FLAGS):

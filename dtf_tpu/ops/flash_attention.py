@@ -47,7 +47,11 @@ from jax.experimental.pallas import tpu as pltpu
 # (~10 TF/s flat, r3); 512x512 fixed that (42-62 TF/s); doubling only the
 # k-extent halves the grid's inner trip count again and keeps the f32
 # score tile at [512,1024] = 2 MB, k/v residents 2x256 KB — far under the
-# ~16 MB VMEM budget. Callers can still override per-shape.
+# ~16 MB VMEM budget. Since the kernel-tune cache landed these are the
+# LAST-RESORT fallback only: block args left at 0 resolve through
+# dtf_tpu.tune.resolver (the banked per-shape winners in
+# KERNEL_TUNE.json, seeded from this very sweep — docs/TUNING.md), and
+# callers can still pin per-shape explicitly.
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 _NEG_INF = float("-inf")
@@ -583,7 +587,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention_sharded(q, k, v, mesh, *, causal: bool = False,
                             window: int = 0,
                             kv_mask: Optional[jax.Array] = None,
-                            block_h: int = 1,
+                            block_h: int = 0,
                             interpret: bool = False) -> jax.Array:
     """Per-shard flash kernel over a (data, model) mesh: batch/head dims are
     partitioned, seq stays whole per shard. Pallas calls can't be
@@ -631,9 +635,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     window: int = 0,
                     kv_mask: Optional[jax.Array] = None,
                     sm_scale: Optional[float] = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
-                    block_h: int = 1,
+                    block_q: int = 0,
+                    block_k: int = 0,
+                    block_h: int = 0,
                     block_q_bwd: int = 0,
                     block_k_bwd: int = 0,
                     interpret: bool = False) -> jax.Array:
@@ -656,12 +660,20 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     (see :func:`_fwd_kernel_hfold`). Must divide ``heads``. Forward only;
     the backward keeps its proven 2-D grids.
 
-    ``block_q_bwd`` / ``block_k_bwd`` (opt-in, 0 = inherit the fwd
-    blocks): separate block shape for the two backward kernels. The
-    backward streams the opposite extents from the forward (``_dq``
-    scans k-blocks, ``_dkv`` scans q-blocks), so the sweep-picked fwd
-    shape is not necessarily bwd-optimal; ``bench_attention.py
-    --sweep-blocks`` measures the bwd rows on chip.
+    ``block_q_bwd`` / ``block_k_bwd`` (0 = auto): separate block shape
+    for the two backward kernels. The backward streams the opposite
+    extents from the forward (``_dq`` scans k-blocks, ``_dkv`` scans
+    q-blocks), so the fwd-optimal shape is not necessarily bwd-optimal;
+    ``bench_attention.py --sweep-blocks`` / ``bench_tune.py`` measure
+    the bwd rows on chip.
+
+    Block arguments left at 0 resolve through the kernel-tune cache
+    (:mod:`dtf_tpu.tune.resolver` — the banked per-shape on-chip
+    winners; docs/TUNING.md), falling back to the module defaults.
+    Explicit values always win; an explicit value that differs from a
+    MEASURED winner warns once. When the forward blocks are pinned
+    explicitly, unset backward blocks keep the old inherit-the-fwd
+    contract instead of mixing a tuned bwd with a pinned fwd.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, H, T, D], got shape {q.shape}")
@@ -670,6 +682,28 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"window={window} must be >= 0 and requires causal=True")
     b, h, t_q, d = q.shape
     t_k = k.shape[2]
+    if not (block_q and block_k and block_h):
+        from dtf_tpu.tune import resolver as _tune
+
+        plan = _tune.flash_plan(
+            seq=t_q, heads=h, head_dim=d, dtype=jnp.dtype(q.dtype).name,
+            causal=causal, window=int(window),
+            n_devices=jax.device_count(),
+            backend=jax.default_backend())
+        for what, explicit, won in (("block_q", block_q, plan.block_q),
+                                    ("block_k", block_k, plan.block_k)):
+            if explicit:
+                _tune.note_override("flash_fwd", what, explicit, won,
+                                    source=plan.source,
+                                    measured=plan.measured)
+        if not (block_q or block_k or block_q_bwd or block_k_bwd):
+            # fully-auto forward: the banked backward winner applies;
+            # a pinned forward keeps bwd on the inherit contract.
+            block_q_bwd, block_k_bwd = plan.block_q_bwd, plan.block_k_bwd
+        block_q = block_q or plan.block_q
+        block_k = block_k or plan.block_k
+        block_h = block_h or plan.block_h
+    block_h = block_h or 1
     if block_h < 1 or h % block_h:
         raise ValueError(f"block_h={block_h} must be >= 1 and divide "
                          f"heads={h}")

@@ -46,6 +46,8 @@ from dtf_tpu.ops.flash_attention import _compiler_params, _pad
 
 _NEG_INF = float("-inf")
 _STAT_LANES = 128
+# Last-resort fallback tile — block args left at 0 resolve through the
+# kernel-tune cache first (dtf_tpu.tune.resolver; docs/TUNING.md).
 DEFAULT_BLOCK_N = 512
 DEFAULT_BLOCK_V = 1024
 
@@ -294,14 +296,19 @@ _fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
 def pallas_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
                             labels: jax.Array, *,
                             ignore_index: int | None = None,
-                            block_n: int = DEFAULT_BLOCK_N,
-                            block_v: int = DEFAULT_BLOCK_V,
+                            block_n: int = 0,
+                            block_v: int = 0,
                             interpret: bool = False,
                             axis_names: tuple = (),
                             ) -> tuple[jax.Array, jax.Array]:
     """(mean_loss, valid_count) — same contract as
     :func:`dtf_tpu.ops.losses.softmax_cross_entropy`, with the [N, V]
     logits living only in VMEM tiles (module docstring).
+
+    ``block_n`` / ``block_v`` left at 0 resolve through the kernel-tune
+    cache (:mod:`dtf_tpu.tune.resolver`; docs/TUNING.md), falling back
+    to the 512x1024 module defaults; explicit values win, warning once
+    when they differ from a measured winner.
 
     ``axis_names``: set when calling from INSIDE a shard_map whose named
     axes shard the tokens — the loss/count psum across them and dW's
@@ -313,6 +320,21 @@ def pallas_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
     xf = x.reshape(-1, x.shape[-1])
     lab = labels.reshape(-1).astype(jnp.int32)
     n = xf.shape[0]
+    if not (block_n and block_v):
+        from dtf_tpu.tune import resolver as _tune
+
+        plan = _tune.fused_ce_plan(
+            vocab=int(w_head.shape[1]), d_model=int(xf.shape[1]),
+            dtype=jnp.dtype(x.dtype).name, n_devices=jax.device_count(),
+            backend=jax.default_backend())
+        for what, explicit, won in (("block_n", block_n, plan.block_n),
+                                    ("block_v", block_v, plan.block_v)):
+            if explicit:
+                _tune.note_override("fused_ce", what, explicit, won,
+                                    source=plan.source,
+                                    measured=plan.measured)
+        block_n = block_n or plan.block_n
+        block_v = block_v or plan.block_v
     bn = min(block_n, max(n, 1))
     bv = min(block_v, max(w_head.shape[1], 1))
     return _fused_ce(xf, w_head, lab, ignore_index, bn, bv, interpret,
@@ -321,8 +343,8 @@ def pallas_lm_cross_entropy(x: jax.Array, w_head: jax.Array,
 
 def pallas_lm_cross_entropy_sharded(x, w_head, labels, mesh, *,
                                     ignore_index: int | None = None,
-                                    block_n: int = DEFAULT_BLOCK_N,
-                                    block_v: int = DEFAULT_BLOCK_V,
+                                    block_n: int = 0,
+                                    block_v: int = 0,
                                     interpret: bool = False):
     """The shard_map boundary for DP/SP meshes: tokens partition over
     (data, seq), ``w_head`` stays replicated, each shard runs the kernel

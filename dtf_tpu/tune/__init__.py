@@ -1,0 +1,45 @@
+"""Kernel autotuner: persistent block-shape / loss-path winners.
+
+PERF.md round 5 closed with every remaining MFU lever measured but
+hand-tuned: the on-chip block sweep showed 512x1024 flash blocks run the
+same attention 2.75x faster than the old 512x512 default, the backward
+runs ~92 TF/s against the forward's ~170 with its own (separately
+swept) block optimum, and the loss-path data says the monolithic
+[B,T,V] matmul wins while it fits and token chunking is the right
+bounded-memory fallback. Each of those findings used to be flipped into
+a hard-coded literal by hand each round. This package is the mechanism
+that does it automatically — the same static-search-then-pin discipline
+the pjit-TPUv4 work applies to sharding (PAPERS.md, arxiv 2204.06514):
+
+- :mod:`dtf_tpu.tune.cache` — the persistent winner store: a committed
+  repo golden ``KERNEL_TUNE.json`` (banked on-chip winners, survives
+  tunnel-down rounds) shadowed by a machine-local
+  ``KERNEL_TUNE.local.json`` next to ``.jax_cache/`` (winners measured
+  on THIS machine, gitignored), with nearest-shape lookup so a query at
+  an unswept shape resolves to the closest banked winner instead of a
+  hard-coded literal.
+- :mod:`dtf_tpu.tune.search` — the candidate spaces, the deterministic
+  winner selection, and the artifact seeding that turns the committed
+  sweep rows (ATTN_BENCH.json block sweeps, BENCH_LM_SWEEP.json loss
+  rows) into golden entries.
+- :mod:`dtf_tpu.tune.resolver` — the read side consumed by the kernels
+  and launchers: ``flash_attention`` / ``pallas_lm_cross_entropy``
+  resolve 0-valued block args here, ``flags.resolve_lm_loss`` resolves
+  the LM loss path here. Explicit values still win (with a warning when
+  they override a measured winner).
+
+``scripts/bench_tune.py`` is the write side: probe-first, watchdogged,
+queued in ``tpu_pipeline.sh`` before the LM benches so their rows are
+measured at tuned defaults. The whole package is jax-free at module
+level (the telemetry/ discipline): resolution must work on a backendless
+machine and must never be the thing that hangs against a dead tunnel.
+
+Docs: docs/TUNING.md.
+"""
+
+from dtf_tpu.tune.cache import (Entry, TuneStore, golden_path,  # noqa: F401
+                                invalidate_cache, load_store, local_path,
+                                merge_entries)
+from dtf_tpu.tune.resolver import (FlashPlan, FusedCePlan,  # noqa: F401
+                                   LossPathPlan, flash_plan, fused_ce_plan,
+                                   invalidate, lm_loss_winner)

@@ -1,0 +1,184 @@
+"""Tuner-backed resolution for kernel block shapes and the LM loss path.
+
+The single choke point the kernels and launchers consult when a block
+argument is left at its 0 sentinel (``flash_attention``,
+``pallas_lm_cross_entropy``) or the loss-path flags are unset
+(``flags.resolve_lm_loss``). Resolution order:
+
+1. explicit caller values — always win; when they override a MEASURED
+   winner at the consulted shape a warning names both (once per process
+   per shape, so a sweep harness doesn't drown in it);
+2. the nearest banked winner from the cache store
+   (``KERNEL_TUNE.local.json`` shadowing the committed
+   ``KERNEL_TUNE.json`` — see :mod:`dtf_tpu.tune.cache`);
+3. the built-in defaults (the round-5 sweep picks, same values the
+   kernels carried as literals before the tuner existed).
+
+Every resolve is process-cached (``lru_cache``): kernels call this
+inside jit traces and a cache-file re-read per call would be absurd.
+The cached plan is a plain frozen dataclass of ints — resolving twice
+returns the identical object, so resolver lookups can never perturb a
+traced program or retrace an AOT one (pinned by
+tests/test_tune.py::test_resolver_never_retraces).
+
+jax-free at module level; callers pass backend/n_devices in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+from dtf_tpu.tune import cache as _cache
+
+# Built-in fallbacks — the round-5 on-chip sweep picks (see
+# ops/flash_attention.py and ops/fused_ce.py for the measurement
+# provenance). The committed KERNEL_TUNE.json carries the same values
+# WITH their measured rows; these literals only fire when both cache
+# files are missing or stale.
+FALLBACK_BLOCK_Q = 512
+FALLBACK_BLOCK_K = 1024
+FALLBACK_BLOCK_N = 512
+FALLBACK_BLOCK_V = 1024
+FALLBACK_SOURCE = "builtin-default (no kernel-tune cache entry)"
+
+
+@dataclasses.dataclass(frozen=True)
+class FlashPlan:
+    block_q: int
+    block_k: int
+    block_h: int
+    #: 0 = no banked backward winner: inherit the forward blocks (the
+    #: pre-tuner contract of ``flash_attention``'s custom_vjp).
+    block_q_bwd: int
+    block_k_bwd: int
+    source: str
+    measured: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class FusedCePlan:
+    block_n: int
+    block_v: int
+    source: str
+    measured: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class LossPathPlan:
+    #: "monolithic" | "chunk_tokens" | "chunk_vocab" | "pallas"
+    path: str
+    chunk: int
+    source: str
+    measured: bool
+
+
+@functools.lru_cache(maxsize=1024)
+def flash_plan(*, seq: int, heads: int, head_dim: int, dtype: str,
+               causal: bool, window: int, n_devices: int = 1,
+               backend: Optional[str] = None) -> FlashPlan:
+    """The tuned flash block shapes for one attention shape."""
+    key = dict(seq=seq, heads=heads, head_dim=head_dim, dtype=dtype,
+               causal=causal, window=window, n_devices=n_devices,
+               backend=backend)
+    store = _cache.load_store()
+    fwd = store.lookup("flash_fwd", key)
+    bwd = store.lookup("flash_bwd", key)
+    bq = bk = bh = 0
+    src, measured = FALLBACK_SOURCE, False
+    if fwd is not None:
+        bq = int(fwd.winner.get("block_q", 0))
+        bk = int(fwd.winner.get("block_k", 0))
+        bh = int(fwd.winner.get("block_h", 1))
+        src, measured = fwd.source, fwd.measured
+    bqb = bkb = 0
+    if bwd is not None:
+        bqb = int(bwd.winner.get("block_q_bwd", 0))
+        bkb = int(bwd.winner.get("block_k_bwd", 0))
+    if bh < 1 or (heads and heads % bh):
+        bh = 1   # a banked fold from a different head count must not
+        # turn into a wrapper ValueError — clamp to the proven kernel
+    return FlashPlan(block_q=bq or FALLBACK_BLOCK_Q,
+                     block_k=bk or FALLBACK_BLOCK_K,
+                     block_h=bh or 1,
+                     block_q_bwd=bqb, block_k_bwd=bkb,
+                     source=src, measured=measured)
+
+
+@functools.lru_cache(maxsize=1024)
+def fused_ce_plan(*, vocab: int, d_model: int, dtype: str,
+                  n_devices: int = 1,
+                  backend: Optional[str] = None) -> FusedCePlan:
+    """The tuned Pallas fused-CE tile shape for one head shape."""
+    key = dict(vocab=vocab, d_model=d_model, dtype=dtype,
+               n_devices=n_devices, backend=backend)
+    e = _cache.load_store().lookup("fused_ce", key)
+    if e is None:
+        return FusedCePlan(FALLBACK_BLOCK_N, FALLBACK_BLOCK_V,
+                           FALLBACK_SOURCE, False)
+    return FusedCePlan(
+        block_n=int(e.winner.get("block_n", 0)) or FALLBACK_BLOCK_N,
+        block_v=int(e.winner.get("block_v", 0)) or FALLBACK_BLOCK_V,
+        source=e.source, measured=e.measured)
+
+
+@functools.lru_cache(maxsize=256)
+def lm_loss_winner(*, fits: bool, vocab: int, seq: int, batch: int,
+                   n_devices: int = 1,
+                   backend: Optional[str] = None
+                   ) -> Optional[LossPathPlan]:
+    """The banked LM loss-path winner for a (fits, shape) bucket, or
+    None when nothing is banked (``flags.resolve_lm_loss`` then applies
+    its HBM heuristic unchanged)."""
+    key = dict(fits=fits, vocab=vocab, seq=seq, batch=batch,
+               n_devices=n_devices, backend=backend)
+    e = _cache.load_store().lookup("lm_loss", key)
+    if e is None or "path" not in e.winner:
+        return None
+    return LossPathPlan(path=str(e.winner["path"]),
+                        chunk=int(e.winner.get("chunk", 0)),
+                        source=e.source, measured=e.measured)
+
+
+@functools.lru_cache(maxsize=256)
+def _warn_override_once(kind: str, what: str, explicit: str,
+                        winner: str, source: str) -> None:
+    try:
+        from absl import logging as absl_logging
+
+        absl_logging.warning(
+            "explicit %s %s=%s overrides the measured kernel-tune "
+            "winner %s (%s); drop the explicit value to track the "
+            "banked optimum, or re-sweep with scripts/bench_tune.py "
+            "if the shape changed", kind, what, explicit, winner, source)
+    except Exception:  # pragma: no cover
+        pass
+
+
+def note_override(kind: str, what: str, explicit, winner, *,
+                  source: str, measured: bool) -> None:
+    """Warn (once per distinct override) when an explicit value beats a
+    measured winner. Policy-seeded (measured=False) entries never warn —
+    overriding a guess is not a finding."""
+    if measured and explicit != winner:
+        _warn_override_once(kind, what, str(explicit), str(winner), source)
+
+
+def _clear_plans() -> None:
+    flash_plan.cache_clear()
+    fused_ce_plan.cache_clear()
+    lm_loss_winner.cache_clear()
+    _warn_override_once.cache_clear()
+
+
+# every store invalidation (including cache.merge_entries writes) must
+# drop the memoized plans too, or a same-process bank-then-resolve
+# serves pre-merge winners; registered once at import.
+_cache.on_invalidate(_clear_plans)
+
+
+def invalidate() -> None:
+    """Drop every resolver/process cache (tests plant cache files via
+    DTF_KERNEL_TUNE_PATH/_GOLDEN and re-resolve)."""
+    _cache.invalidate_cache()     # store + registered plan caches

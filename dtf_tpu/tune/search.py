@@ -1,0 +1,319 @@
+"""Candidate spaces, deterministic winner selection, artifact seeding.
+
+The WRITE side of the tuner: ``scripts/bench_tune.py`` measures the
+candidate grids below on chip and banks winners through
+:func:`select_winner`; :func:`seed_entries` re-derives the committed
+``KERNEL_TUNE.json`` golden from the sweep artifacts already in the
+repo (ATTN_BENCH.json block sweeps, BENCH_LM_SWEEP.json loss rows) so a
+round that only banks raw rows — the sentinel's job — still flips
+defaults the moment ``python -m dtf_tpu.tune seed`` (or bench_tune
+itself, which runs the selection step even against a dead tunnel) is
+run. No hand-transcription of winners into literals, ever again.
+
+Winner selection is DETERMINISTIC on purpose: min metric, ties broken
+by the canonical JSON of the candidate params — two runs over the same
+rows bank the same winner, and tests inject synthetic timings to pin
+the ordering (tests/test_tune.py).
+
+How a new kernel registers candidates: add a ``<kind>_candidates()``
+grid here, give the kernel a 0-sentinel block argument resolved through
+a :mod:`dtf_tpu.tune.resolver` plan, teach ``bench_tune.py`` to time
+the grid, and extend :func:`seed_entries` if its rows land in a
+committed artifact (docs/TUNING.md walks an example).
+
+jax-free at module level (package discipline).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from dtf_tpu.tune.cache import Entry
+
+#: forward block grid (the round-5 sweep's shapes): square vs
+#: rectangular vs doubled-k, the axes that moved the needle on v5e.
+FLASH_FWD_CANDIDATES = ((256, 256), (512, 512), (512, 1024), (1024, 512),
+                        (1024, 1024), (512, 2048))
+#: backward grid (fwd pinned at its winner): the _dq/_dkv kernels stream
+#: the opposite extents from the forward, so the optimum may differ —
+#: (512, 1024) repeats the inherited default as a same-window control.
+FLASH_BWD_CANDIDATES = ((512, 512), (1024, 512), (512, 1024),
+                        (1024, 1024), (256, 1024))
+#: fused-CE tile grid: token-block x vocab-block around the 512x1024
+#: default (VMEM bound ~8 MB at D<=1024 — fused_ce.py docstring).
+FUSED_CE_CANDIDATES = ((256, 1024), (512, 512), (512, 1024), (512, 2048),
+                       (1024, 1024))
+#: LM loss paths A/B'd by bench_tune (chunk values are the banked sweep
+#: shapes: AUTO_LOSS_CHUNK_TOKENS / the vocab ladder's 8192).
+LM_LOSS_CANDIDATES = (("monolithic", 0), ("chunk_tokens", 4096),
+                      ("chunk_vocab", 8192), ("pallas", 0))
+
+
+def flash_fwd_candidates(seq: int) -> list[tuple[int, int]]:
+    """The fwd grid clamped to the sequence (a block wider than T just
+    re-measures the T-sized clamp the wrapper applies)."""
+    out, seen = [], set()
+    for bq, bk in FLASH_FWD_CANDIDATES:
+        c = (min(bq, seq), min(bk, seq))
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def flash_bwd_candidates(seq: int) -> list[tuple[int, int]]:
+    out, seen = [], set()
+    for bq, bk in FLASH_BWD_CANDIDATES:
+        c = (min(bq, seq), min(bk, seq))
+        if c not in seen:
+            seen.add(c)
+            out.append(c)
+    return out
+
+
+def select_winner(rows: list[dict], *, metric: str,
+                  lower_is_better: bool = True) -> Optional[dict]:
+    """The winning row: best ``metric``, deterministic tie-break.
+
+    Rows missing the metric (a child that died mid-sweep) are skipped;
+    an empty field → None (caller keeps the previous winner). Ties
+    break on the canonical JSON of the row so injected-equal timings
+    still select reproducibly."""
+    live = [r for r in rows
+            if isinstance(r.get(metric), (int, float))]
+    if not live:
+        return None
+    sign = 1.0 if lower_is_better else -1.0
+    return min(live, key=lambda r: (sign * float(r[metric]),
+                                    json.dumps(r, sort_keys=True)))
+
+
+# --------------------------------------------------------------- seeding
+
+
+def _read_json(path: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return {}
+
+
+def _attn_key(row: dict, backend: str = "tpu") -> dict:
+    return dict(seq=int(row.get("seq", 0)), heads=int(row.get("h", 0)),
+                head_dim=int(row.get("d", 0)),
+                dtype=str(row.get("dtype", "bfloat16")), causal=True,
+                window=0, n_devices=1, backend=backend)
+
+
+#: bench_tune.py persists its raw on-chip sweep rows here (committed),
+#: so the golden is ALWAYS re-derivable from artifacts — a re-seed
+#: after a measuring round reproduces the measured winners instead of
+#: reverting them to older data.
+SWEEP_ARTIFACT = "KERNEL_TUNE_SWEEP.json"
+
+
+def _shape_of(row: dict) -> tuple:
+    return (int(row.get("seq", 0)), int(row.get("h", 0)),
+            int(row.get("d", 0)), str(row.get("dtype", "bfloat16")))
+
+
+def _is_bwd_row(row: dict) -> bool:
+    return bool(row.get("block_q_bwd") or row.get("block_k_bwd"))
+
+
+def seed_flash_entries(root: str) -> list[Entry]:
+    """flash_fwd/flash_bwd winners per SHAPE from the banked sweeps:
+    ATTN_BENCH.json's ``tpu.block_sweep`` / ``tpu.bwd_block_sweep``
+    plus bench_tune's own persisted rows (KERNEL_TUNE_SWEEP.json).
+
+    - fwd: min ``flash_fwd_s`` over the shape's fwd rows.
+    - bwd: min ``flash_fwdbwd_s`` over the shape's STANDALONE bwd rows
+      (block_q_bwd/block_k_bwd set, fwd pinned) when any exist;
+      otherwise the shape's best fwd+bwd row seeds the INHERITED pair
+      that measurement actually ran — so the default comes from data
+      either way, and re-seeding after the sentinel banks the
+      standalone rows flips it to the independent optimum automatically.
+    """
+    tpu = _read_json(os.path.join(root, "ATTN_BENCH.json")).get("tpu", {})
+    rows = list((tpu.get("block_sweep") or {}).get("rows") or [])
+    rows += list((tpu.get("bwd_block_sweep") or {}).get("rows") or [])
+    rows += [r for r in _read_json(
+        os.path.join(root, SWEEP_ARTIFACT)).get("rows", [])
+        if r.get("backend") == "tpu"]
+    shapes: dict[tuple, dict] = {}
+    for r in rows:
+        if not all(_shape_of(r)[:3]):
+            continue
+        g = shapes.setdefault(_shape_of(r), {"fwd": [], "bwd": []})
+        g["bwd" if _is_bwd_row(r) else "fwd"].append(r)
+    entries: list[Entry] = []
+    for g in shapes.values():
+        fwd = select_winner(g["fwd"], metric="flash_fwd_s")
+        if fwd:
+            entries.append(Entry(
+                kind="flash_fwd", key=_attn_key(fwd),
+                winner={"block_q": int(fwd["block_q"]),
+                        "block_k": int(fwd["block_k"]),
+                        "block_h": int(fwd.get("block_h", 1))},
+                metric={"flash_fwd_s": fwd.get("flash_fwd_s"),
+                        "flash_fwd_tflops": fwd.get("flash_fwd_tflops")},
+                source=("banked fwd block-sweep rows (ATTN_BENCH.json / "
+                        "KERNEL_TUNE_SWEEP.json, v5e)"),
+                measured=True))
+        if g["bwd"]:
+            bwd = select_winner(g["bwd"], metric="flash_fwdbwd_s")
+            if bwd:
+                entries.append(Entry(
+                    kind="flash_bwd", key=_attn_key(bwd),
+                    winner={"block_q_bwd": int(bwd.get("block_q_bwd")
+                                               or 0),
+                            "block_k_bwd": int(bwd.get("block_k_bwd")
+                                               or 0)},
+                    metric={"flash_fwdbwd_s": bwd.get("flash_fwdbwd_s")},
+                    source=("banked STANDALONE bwd block-sweep rows "
+                            "(fwd pinned; ATTN_BENCH.json / "
+                            "KERNEL_TUNE_SWEEP.json, v5e)"),
+                    measured=True))
+        elif fwd is not None:
+            bwd = select_winner(g["fwd"], metric="flash_fwdbwd_s")
+            if bwd:
+                entries.append(Entry(
+                    kind="flash_bwd", key=_attn_key(bwd),
+                    winner={"block_q_bwd": int(bwd["block_q"]),
+                            "block_k_bwd": int(bwd["block_k"])},
+                    metric={"flash_fwdbwd_s": bwd.get("flash_fwdbwd_s")},
+                    source=("banked fwd+bwd rows (bwd INHERITED the fwd "
+                            "blocks in this measurement; the standalone "
+                            "bwd sweep is queued — bench_attention "
+                            "--sweep-blocks-bwd / bench_tune — and "
+                            "re-seeding banks its independent optimum)"),
+                    measured=True))
+    return entries
+
+
+def _lm_row_path(row: dict) -> tuple[str, int]:
+    if row.get("loss_pallas"):
+        return "pallas", 0
+    if row.get("loss_chunk_tokens"):
+        return "chunk_tokens", int(row["loss_chunk_tokens"])
+    if row.get("loss_chunk"):
+        return "chunk_vocab", int(row["loss_chunk"])
+    return "monolithic", 0
+
+
+def seed_lm_loss_entries(root: str) -> list[Entry]:
+    """lm_loss winners per fits-bucket from the GPT sweep rows.
+
+    Bucketing uses the same per-device HBM estimate as
+    ``flags.resolve_lm_loss`` (logits + cotangent vs the budget
+    fraction), so a banked winner lands in exactly the bucket the
+    resolver will query. Within the fits=True bucket the data decides
+    outright (round 5: monolithic 58.0%% vs vocab-chunk 48.9%%). In the
+    fits=False bucket only the vocab scan is measured so far; the
+    token-chunk A/B rides the bench_tune queue, and until it banks, the
+    entry encodes the PERF.md §0b chunk-axis ordering (token chunking:
+    one full-vocab MXU matmul per block vs the serialized vocab scan
+    that costs ~9 MFU points) as a measured=False policy winner — the
+    measured vocab rows are recorded as alternatives in the metric."""
+    from dtf_tpu.cli.flags import (AUTO_LOSS_CHUNK_TOKENS,
+                                   HBM_BYTES_PER_CHIP,
+                                   LOGITS_HBM_FRACTION)
+
+    raw = list(_read_json(
+        os.path.join(root, "BENCH_LM_SWEEP.json")).get("rows", []))
+    # bench_tune's own A/B rows (BENCH_LM.json "loss_path") join the
+    # pool — newer rows land later and win ties deterministically only
+    # via the canonical-JSON tie-break, but a real delta decides on data.
+    raw += list((_read_json(os.path.join(root, "BENCH_LM.json"))
+                 .get("loss_path") or {}).get("rows", []))
+    rows = [r for r in raw
+            if r.get("model") == "gpt" and r.get("phase", "step") == "step"
+            and r.get("gpt_size", "small") == "small"]
+    buckets: dict[bool, list[dict]] = {True: [], False: []}
+    vocab = 50304   # the GPT flagship vocab (models/gpt.py)
+    for r in rows:
+        batch, seq = int(r.get("batch", 0)), int(r.get("seq", 0))
+        if not (batch and seq):
+            continue
+        est = 2 * batch * seq * vocab * 4
+        fits = est <= LOGITS_HBM_FRACTION * HBM_BYTES_PER_CHIP
+        path, chunk = _lm_row_path(r)
+        buckets[fits].append({
+            "path": path, "chunk": chunk, "batch": batch, "seq": seq,
+            "mfu": r.get("mfu_analytic"),
+            "tokens_per_sec": r.get("tokens_per_sec")})
+    entries: list[Entry] = []
+    for fits, brows in buckets.items():
+        if not brows:
+            continue
+        alts = {f"{b['path']}_b{b['batch']}": b["mfu"] for b in brows
+                if isinstance(b.get("mfu"), (int, float))}
+        rep = brows[0]
+        key = dict(fits=fits, vocab=vocab, seq=rep["seq"],
+                   batch=rep["batch"], n_devices=1, backend="tpu")
+        best = select_winner(brows, metric="mfu", lower_is_better=False)
+        paths = {b["path"] for b in brows}
+        if fits or (best and best["path"] != "chunk_vocab") or \
+                "chunk_tokens" in paths:
+            if best is None:
+                continue
+            entries.append(Entry(
+                kind="lm_loss", key=key,
+                winner={"path": best["path"], "chunk": best["chunk"]},
+                metric={"mfu": best["mfu"], "alternatives": alts},
+                source=("BENCH_LM_SWEEP.json gpt rows (v5e, round 5): "
+                        "best measured mfu_analytic in this fits bucket"),
+                measured=True))
+        else:
+            # only the vocab scan is measured where logits don't fit:
+            # bank the PERF-ordered token-chunk preference until the
+            # bench_tune A/B replaces it with data.
+            entries.append(Entry(
+                kind="lm_loss", key=key,
+                winner={"path": "chunk_tokens",
+                        "chunk": AUTO_LOSS_CHUNK_TOKENS},
+                metric={"alternatives": alts},
+                source=("PERF.md §0b/§0c chunk-axis ordering (vocab "
+                        "scan costs ~9 MFU points; token chunking is "
+                        "one full-vocab MXU matmul per block). The "
+                        "mono/token/pallas A/B rows ride bench_tune's "
+                        "loss_path queue; re-seed after they bank."),
+                measured=False))
+    return entries
+
+
+def cpu_sim_fallback_entries() -> list[Entry]:
+    """Deterministic CPU-sim entries mirroring the built-in defaults.
+
+    Interpret-mode timings are not MXU-predictive, so the CPU sim
+    should resolve like the chip does — nearest-shape lookup already
+    lands on the banked tpu winners; these entries exist so a tree with
+    a pruned tpu section still resolves deterministically (and so tests
+    have a stable backend='cpu' row to assert against)."""
+    src = ("cpu_sim_fallback: mirrors the built-in defaults — "
+           "interpret-mode timing is not predictive of the MXU")
+    return [
+        Entry(kind="flash_fwd",
+              key=dict(seq=1024, heads=12, head_dim=64, dtype="bfloat16",
+                       causal=True, window=0, n_devices=8, backend="cpu"),
+              winner={"block_q": 512, "block_k": 1024, "block_h": 1},
+              source=src, measured=False),
+        Entry(kind="fused_ce",
+              key=dict(vocab=50304, d_model=768, dtype="bfloat16",
+                       n_devices=8, backend="cpu"),
+              winner={"block_n": 512, "block_v": 1024},
+              source=src, measured=False),
+    ]
+
+
+def seed_entries(root: Optional[str] = None) -> list[Entry]:
+    """Everything the committed artifacts support, in one list."""
+    from dtf_tpu.tune.cache import repo_root
+
+    root = root or repo_root()
+    return (seed_flash_entries(root) + seed_lm_loss_entries(root)
+            + cpu_sim_fallback_entries())

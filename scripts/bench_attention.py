@@ -176,7 +176,13 @@ def tpu_child():
     from dtf_tpu.ops import attention as att
     from dtf_tpu.ops import flash_attention as fa
 
-    b, h, d = 2, 8, 128
+    # batch/heads/head_dim default to the long-context bench shape;
+    # bench_tune.py's children override them to sweep the TRAIN shapes
+    # (e.g. GPT-2-small's b8 h12 d64 s1024) through this same
+    # scan-amortized machinery.
+    b = int(os.environ.get("DTF_ATTN_B", "2"))
+    h = int(os.environ.get("DTF_ATTN_H", "8"))
+    d = int(os.environ.get("DTF_ATTN_D", "128"))
     t = int(os.environ["DTF_ATTN_SEQ"])
     # block-shape override for the MXU-roof sweep (VERDICT r3 #4): the
     # 512x512 default is a diagnosis-driven guess; the sweep measures it
@@ -263,12 +269,30 @@ def tpu_child():
     # HBM alongside operands — record that as the finding, don't crash.
     dense_ok = b * h * t * t * 4 < 6e9
 
+    # report the blocks that actually run: unset args resolve through
+    # the kernel-tune cache now (a row must not claim the module default
+    # while the kernel ran a banked winner)
+    from dtf_tpu.tune import resolver as tune_resolver
+
+    plan = tune_resolver.flash_plan(
+        seq=t, heads=h, head_dim=d, dtype="bfloat16", causal=True,
+        window=0, n_devices=jax.device_count(),
+        backend=jax.default_backend())
+    # mirrors flash_attention's plan gate EXACTLY: the banked bwd pair
+    # applies only on the fully-auto path; any explicit block (fwd or
+    # bwd) keeps unset bwd fields on the inherit-the-fwd contract, and
+    # a misreported pair here would be persisted and seeded as a
+    # "measured" winner for blocks that never ran
+    auto_bwd = not (blk_q or blk_k or blk_qb or blk_kb)
+    eff_bqb = blk_qb or (plan.block_q_bwd if auto_bwd else 0)
+    eff_bkb = blk_kb or (plan.block_k_bwd if auto_bwd else 0)
     row = {"seq": t, "backend": jax.default_backend(), "b": b, "h": h,
            "d": d, "dtype": "bfloat16", "null_jit_s": round(null_s, 5),
            "reps_fwd": r_fwd, "reps_fwdbwd": r_bwd,
-           "block_q": min(blk_q or fa.DEFAULT_BLOCK_Q, t),
-           "block_k": min(blk_k or fa.DEFAULT_BLOCK_K, t),
-           "block_h": blk_h or 1, "block_q_bwd": blk_qb, "block_k_bwd": blk_kb}
+           "block_q": min(blk_q or plan.block_q, t),
+           "block_k": min(blk_k or plan.block_k, t),
+           "block_h": blk_h or plan.block_h,
+           "block_q_bwd": eff_bqb, "block_k_bwd": eff_bkb}
     row["flash_fwd_s"] = round(scan_timed(fwd_step(flash), q, r_fwd), 6)
     row["flash_fwdbwd_s"] = round(scan_timed(fwdbwd_step(flash), q, r_bwd), 6)
     if t >= 4096:

@@ -18,6 +18,10 @@
 set -x
 cd "$(dirname "$0")/.." || exit 1
 python scripts/tpu_smoke.py
+# autotune FIRST (after the correctness gate): banks block-shape +
+# loss-path winners into KERNEL_TUNE.json so every bench below — and
+# the PR 8 MFU fences — measures at tuned defaults (docs/TUNING.md)
+python scripts/bench_tune.py
 python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --sweep-bert

@@ -234,18 +234,21 @@ def main(argv):
                 "(all-gathering W per chunk)")
         model, init_fn = gpt.make_init(cfg, mesh, seq_len=FLAGS.seq_len)
         # auto loss path: monolithic logits when they fit HBM (fastest),
-        # token-chunked fused CE when they don't; explicit flags win but
-        # warn when they force the ~9-MFU-point slower path (PERF.md 0c)
-        lchunk, tchunk = dflags.resolve_lm_loss(
+        # the banked kernel-tune winner — token-chunked fused CE by
+        # default — when they don't; explicit flags win but warn when
+        # they force a measured-slower path (PERF.md 0c, docs/TUNING.md)
+        lpath = dflags.resolve_lm_loss(
             FLAGS, batch=FLAGS.batch_size, seq_len=FLAGS.seq_len,
             vocab_size=cfg.vocab_size, mesh_shape=dict(mesh.shape))
+        lchunk, tchunk = lpath.chunk_vocab, lpath.chunk_tokens
+        lpallas = FLAGS.loss_pallas or lpath.pallas
         loss_fn = gpt.make_loss(model, loss_chunk=lchunk,
                                 loss_chunk_tokens=tchunk,
-                                loss_pallas=FLAGS.loss_pallas)
+                                loss_pallas=lpallas)
         param_rules = gpt.tp_rules
         eval_fn = gpt.make_eval(model, loss_chunk=lchunk,
                                 loss_chunk_tokens=tchunk,
-                                loss_pallas=FLAGS.loss_pallas)
+                                loss_pallas=lpallas)
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=param_rules, zero1=FLAGS.zero1)
@@ -291,7 +294,7 @@ def main(argv):
             # model's dense path is pure GSPMD).
             blockers.append(f"attention impl {eff_attn!r} runs in "
                             "shard_map (use --attn_impl=dense)")
-        if FLAGS.loss_pallas:
+        if FLAGS.loss_pallas or (not pipelined and lpath.pallas):
             blockers.append("--loss_pallas fused CE runs in shard_map")
         if FLAGS.tp_overlap and mesh.shape.get("model", 1) > 1:
             blockers.append("--tp_overlap collective matmuls run in "

@@ -255,9 +255,12 @@ def test_decoupled_decay_promotes_recipe_l2():
 def test_resolve_lm_loss_auto_picks_from_hbm_estimate():
     """ISSUE 2 satellite: the LM loss path is an HBM decision (PERF.md 0c
     — chunking costs ~9 GPT MFU points, it is a memory lever). Monolithic
-    when the [B,T,V] logits fit per device, token-chunked when they
-    don't; explicit flags win (with a warning when they force the slow
-    path on a fitting config)."""
+    when the [B,T,V] logits fit per device, the banked kernel-tune
+    winner (token-chunked by default) when they don't; explicit flags
+    win (with a warning when they force the slow path on a fitting
+    config). Returns LmLossPath; the chunk fields destructure like the
+    old 2-tuple (sliced here). Tuner-winner paths are pinned separately
+    in tests/test_tune.py."""
     from unittest import mock
 
     from dtf_tpu.cli.flags import AUTO_LOSS_CHUNK_TOKENS, resolve_lm_loss
@@ -270,26 +273,28 @@ def test_resolve_lm_loss_auto_picks_from_hbm_estimate():
 
     gpt = dict(seq_len=1024, vocab_size=50304)
     # b8 s1024 V50k: ~3.3 GB logits+cotangent -> fits, monolithic
-    assert resolve_lm_loss(lf(), batch=8, **gpt) == (0, 0)
-    # b32: ~13 GB -> auto-select the token-chunked fused loss
-    assert resolve_lm_loss(lf(), batch=32, **gpt) == (
-        0, AUTO_LOSS_CHUNK_TOKENS)
+    assert resolve_lm_loss(lf(), batch=8, **gpt)[:2] == (0, 0)
+    # b32: ~13 GB -> the token-chunked fused loss (banked winner and
+    # heuristic default agree)
+    r = resolve_lm_loss(lf(), batch=32, **gpt)
+    assert r[:2] == (0, AUTO_LOSS_CHUNK_TOKENS) and not r.pallas
     # data/seq sharding divides the per-device logits share back under
     # the budget
     assert resolve_lm_loss(lf(), batch=32, mesh_shape={"data": 4},
-                           **gpt) == (0, 0)
+                           **gpt)[:2] == (0, 0)
     # fused losses cannot ride a TP/pipe mesh: monolithic even when big
     assert resolve_lm_loss(lf(), batch=32, mesh_shape={"model": 2},
-                           **gpt) == (0, 0)
+                           **gpt)[:2] == (0, 0)
     assert resolve_lm_loss(lf(), batch=32, mesh_shape={"pipe": 2},
-                           **gpt) == (0, 0)
+                           **gpt)[:2] == (0, 0)
     # explicit flags are honored either way; forcing the slow path on a
-    # fitting config warns
+    # fitting config warns, as does the vocab scan where the banked
+    # winner is the token axis
     with mock.patch("absl.logging.warning") as warn:
-        assert resolve_lm_loss(lf(loss_chunk_vocab=8192), batch=8,
-                               **gpt) == (8192, 0)
+        r = resolve_lm_loss(lf(loss_chunk_vocab=8192), batch=8, **gpt)
+        assert r[:2] == (8192, 0) and r.source == "explicit"
         assert warn.called
     with mock.patch("absl.logging.warning") as warn:
         assert resolve_lm_loss(lf(loss_chunk_tokens=4096), batch=32,
-                               **gpt) == (0, 4096)
+                               **gpt)[:2] == (0, 4096)
         assert not warn.called   # logits do NOT fit: the flag is right
