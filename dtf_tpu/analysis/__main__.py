@@ -86,6 +86,15 @@ def _fit_main(argv: list[str]) -> int:
                              "(≈ (L·T·d)_real/(L·T·d)_program) — switches "
                              "the resident side to the real-scale spec "
                              "view")
+    parser.add_argument("--hosts", type=int, default=None,
+                        help="train: the mesh is split across N hosts — "
+                             "price the elastic shrink (with --lost) "
+                             "before the controller relaunches "
+                             "(docs/RESILIENCE.md)")
+    parser.add_argument("--lost", type=int, default=0,
+                        help="train: hosts lost; the survivor mesh "
+                             "(data axis scaled down) is priced at the "
+                             "SAME global batch next to the full mesh")
     args = parser.parse_args(argv)
 
     from dtf_tpu.analysis import configs as cfgs
@@ -101,7 +110,7 @@ def _fit_main(argv: list[str]) -> int:
             args.config, hbm_gb=args.hbm_gb, max_len=args.max_len,
             kv_page_size=args.kv_page_size, slots=args.slots, opt=args.opt,
             grad_accum=args.grad_accum, grad_shard=args.grad_shard,
-            act_scale=args.act_scale)
+            act_scale=args.act_scale, hosts=args.hosts, lost=args.lost)
     except Exception as e:  # noqa: BLE001 — last line must still be JSON
         print(json.dumps({"ok": False,
                           "error": f"{type(e).__name__}: {e}"[:500]}))

@@ -574,19 +574,21 @@ def _scale_batch(batch: PyTree, b: int) -> PyTree:
 
 def _fit_train(config, hbm_bytes: int, *, opt: Optional[str],
                grad_accum: int, grad_shard: bool,
-               act_scale: Optional[float]) -> dict:
+               act_scale: Optional[float], mesh=None) -> dict:
     """Train planning: analytic resident state + a measured affine
     temp-vs-batch model (two AOT compiles of the registry's own tiny
     program).  The batch inversion answers at PROGRAM scale — the same
     program the fence pins; ``act_scale`` (≈ (L·T·d)_real/(L·T·d)_tiny
     for the LM configs) extrapolates the activation slope to the
     real-scale model and prices the resident side from the real-scale
-    spec view instead."""
+    spec view instead.  ``mesh`` overrides the config's own mesh — the
+    elastic shrink pricing (``fit --hosts --lost``) reuses this whole
+    path on the survivor mesh, no new compile machinery."""
     import jax
     from dtf_tpu.analysis import configs as cfgs
     from dtf_tpu.core import sharding as shd
 
-    mesh = config.mesh()
+    mesh = config.mesh() if mesh is None else mesh
     data_size = int(mesh.shape.get("data", 1))
     opt_name = opt or config.opt_name
     tx = cfgs.OPTIMIZER_FAMILIES[opt_name]()
@@ -637,14 +639,23 @@ def _fit_train(config, hbm_bytes: int, *, opt: Optional[str],
     max_batch = int(avail // per_row) if per_row > 0 and avail > 0 else 0
     grain = data_size * max(grad_accum, 1)
     max_batch -= max_batch % grain
+    # fit verdict at the program's OWN global batch — the elastic shrink
+    # question ("does the survivor mesh still carry the same global
+    # batch?") is this number on the shrunk mesh vs the budget.
+    need_at_b0 = int(resident["total_bytes"] + intercept * scale
+                     + per_row * b0)
     return {
         "scale": label, "opt": opt_name,
         "grad_accum": grad_accum, "grad_shard": grad_shard,
+        "mesh": dict(mesh.shape),
         "resident_bytes_per_device": resident,
         "temp_model": {"intercept_bytes": int(intercept),
                        "bytes_per_batch_row": int(round(per_row)),
                        "measured": {str(k): v for k, v in temps.items()}},
         "act_scale": scale,
+        "global_batch": b0,
+        "hbm_needed_bytes_at_batch": need_at_b0,
+        "fits_at_batch": bool(need_at_b0 <= hbm_bytes),
         "max_global_batch": max(0, max_batch),
     }
 
@@ -653,18 +664,59 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
         kv_page_size: int = 64, slots: Optional[int] = None,
         opt: Optional[str] = None, grad_accum: int = 1,
         grad_shard: bool = False,
-        act_scale: Optional[float] = None) -> dict:
+        act_scale: Optional[float] = None,
+        hosts: Optional[int] = None, lost: int = 0) -> dict:
     """The fit planner: what fits a ``hbm_gb``-HBM chip under config
     ``name``'s mesh and sharding rules.  Serve configs answer max KV
     slots (bf16 AND int8) + page-pool size from a pure ``eval_shape``
     pricing at REAL model scale; train configs answer max global batch
-    from analytic resident state + a measured temp model."""
+    from analytic resident state + a measured temp model.
+
+    ``hosts``/``lost`` (train configs): price the elastic shrink BEFORE
+    the controller pays a relaunch — the config's mesh is split across
+    ``hosts`` hosts, ``lost`` of them die, and the survivor mesh (data
+    axis scaled down, everything else intact — ``fault/elastic.py``) is
+    priced side by side with the full mesh at the SAME global batch.
+    ``survivor.fits_at_batch`` is the controller's go/no-go: resident
+    state grows (ZeRO-1 shards are 1/data') and temp grows (bigger
+    per-device batch), so a shrink that no longer fits should relaunch
+    at a smaller batch or fail loudly, not OOM on the chip.
+    """
     from dtf_tpu.analysis import configs as cfgs
 
     config = cfgs.BY_NAME[name]
     hbm_bytes = int(hbm_gb * (1 << 30))
     out = {"mode": "fit", "config": name, "hbm_gb": hbm_gb,
            "mesh": dict(config.mesh().shape)}
+    if hosts is not None:
+        if config.fit_serve_cfg is not None:
+            raise ValueError(
+                "--hosts/--lost prices train meshes; a serve fleet "
+                "shrinks by replica count, not mesh surgery")
+        import jax
+
+        from dtf_tpu.core.mesh import MeshConfig, make_mesh
+        from dtf_tpu.fault.elastic import survivor_mesh_shape
+
+        surv_shape = survivor_mesh_shape(out["mesh"], hosts, lost)
+        n_surv = int(np.prod(list(surv_shape.values())))
+        if n_surv > len(jax.devices()):
+            raise ValueError(
+                f"survivor mesh needs {n_surv} devices; the sim has "
+                f"{len(jax.devices())}")
+        surv_mesh = make_mesh(MeshConfig(**surv_shape),
+                              devices=jax.devices()[:n_surv])
+        kw = dict(opt=opt, grad_accum=grad_accum, grad_shard=grad_shard,
+                  act_scale=act_scale)
+        out.update({
+            "kind": "train_shrink", "hosts": hosts, "lost": lost,
+            "survivor_mesh": surv_shape,
+            "full": _fit_train(config, hbm_bytes, **kw),
+            "survivor": _fit_train(config, hbm_bytes, mesh=surv_mesh,
+                                   **kw),
+        })
+        out["survivor_fits_same_batch"] = out["survivor"]["fits_at_batch"]
+        return out
     if config.fit_serve_cfg is not None:
         out["kind"] = "serve"
         out.update(_fit_serve(config, hbm_bytes, max_len=max_len,

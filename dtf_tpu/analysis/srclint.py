@@ -207,16 +207,21 @@ def lint_file(path: str) -> list[str]:
             "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
         problems += _hotpath_readbacks(tree, path, noqa, src)
 
-    # ---- backend imports fenced out of telemetry/ AND tune/ ----
+    # ---- backend imports fenced out of telemetry/, tune/ AND fault/ ----
     # telemetry: reports parse traces on chipless machines. tune: the
     # bench_tune parent imports the package BEFORE probing the backend
     # (dead-tunnel rc-0 contract) — a module-level jax import in either
-    # can hang a live-axon process before any code runs.
+    # can hang a live-axon process before any code runs. fault: the run
+    # controller supervises possibly-WEDGED backends from a clean chief
+    # process — importing the thing it must outlive would be fatal.
     for pkg, why in (("telemetry", "reports parse traces on chipless "
                       "machines; an axon-env jax import can hang"),
                      ("tune", "bench_tune's parent imports it BEFORE "
                       "probing the backend — a module-level backend "
-                      "import hangs the dead-tunnel rc-0 path")):
+                      "import hangs the dead-tunnel rc-0 path"),
+                     ("fault", "the run controller supervises a possibly-"
+                      "wedged backend from a clean process and must "
+                      "never import what it has to outlive")):
         in_pkg = (pkg in dirs if anchored
                   else bool(dirs) and dirs[-1] == pkg)
         if in_pkg:

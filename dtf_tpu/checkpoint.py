@@ -13,6 +13,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import time
 from typing import Any
 
 import jax
@@ -62,6 +63,22 @@ def load_model_config(directory: str | os.PathLike) -> dict | None:
         return None
 
 
+#: substrings Orbax puts in TARGET-mismatch errors (the restore tree's
+#: SHAPE vs what was saved — e.g. "Dict key mismatch; expected keys:
+#: [...]", "User-provided restore item and on-disk value mismatch").
+#: Deliberately narrow: corruption can surface as a tensorstore
+#: "checksum mismatch", which must stay on the fall-back path — so plain
+#: "mismatch" is not enough of a signature. Unknown error classes keep
+#: the old fall-back behavior; only unambiguous wrong-target phrasings
+#: re-raise. Verified against both classes in tests/test_elastic.py.
+_STRUCTURAL_ERROR_MARKERS = ("key mismatch", "user-provided restore item",
+                             "tree structure")
+
+
+def _looks_structural(e: Exception) -> bool:
+    return any(m in str(e).lower() for m in _STRUCTURAL_ERROR_MARKERS)
+
+
 class Checkpointer:
     """Thin Orbax CheckpointManager wrapper for TrainState pytrees."""
 
@@ -77,6 +94,9 @@ class Checkpointer:
                 enable_async_checkpointing=async_save,
             ),
         )
+        #: the step the last guarded latest-step restore actually loaded
+        #: (may be OLDER than latest when the newest step was unreadable)
+        self._last_restored_step: int | None = None
 
     @property
     def directory(self) -> str:
@@ -113,6 +133,46 @@ class Checkpointer:
                                     params=ocp.args.StandardSave(params)),
             force=force)
 
+    def save_durable(self, step: int, state: PyTree, *, retries: int = 2,
+                     backoff_s: float = 0.25, sleep=None) -> bool:
+        """Force-save ``step`` and block until durable, retrying transient
+        failures with exponential backoff.
+
+        The PreemptionHook path: a save failing inside the SIGTERM grace
+        window (filesystem blip, transient quota) must not forfeit the
+        whole window — retry ``retries`` times, and if every attempt
+        fails, log the error and return False so the caller can still exit
+        cleanly on the PREVIOUS checkpoint (Orbax writes are atomic: a
+        failed attempt leaves no half-step behind for restore to trip on).
+        """
+        sleep = sleep or time.sleep
+        for attempt in range(retries + 1):
+            try:
+                self.save(step, state, force=True)
+                self.wait()
+                return True
+            except Exception as e:  # noqa: BLE001 — any failure class
+                # here must degrade to "previous checkpoint", not a crash
+                try:
+                    self._mgr.wait_until_finished()
+                except Exception:   # noqa: BLE001 — the failed async
+                    pass            # save's own error re-raised; drained
+                if attempt == retries:
+                    log.error(
+                        "checkpoint save at step %d failed after %d "
+                        "attempt(s) (%s: %s); the previous checkpoint "
+                        "(step %s) remains the resume point",
+                        step, retries + 1, type(e).__name__, e,
+                        self._mgr.latest_step())
+                    return False
+                delay = backoff_s * (2 ** attempt)
+                log.warning(
+                    "checkpoint save at step %d failed (%s: %s); "
+                    "retrying in %.2fs (%d/%d)",
+                    step, type(e).__name__, e, delay, attempt + 1, retries)
+                sleep(delay)
+        return False
+
     def _has_item(self, step: int, item: str) -> bool:
         """True when ``step`` was saved in the two-item layout and carries
         ``item`` (legacy checkpoints keep everything under ``default``)."""
@@ -121,17 +181,7 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
-    def restore(self, target: PyTree, step: int | None = None) -> PyTree:
-        """Restore into the shardings of ``target``.
-
-        ``target`` may be a concrete sharded TrainState (its leaves' shardings
-        are reused — the restore-if-exists moment of ``ChiefSessionCreator``)
-        or a pytree of ShapeDtypeStruct with shardings.
-        """
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoint found under {self.directory}")
+    def _restore_one(self, target: PyTree, step: int) -> PyTree:
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=x.sharding)
@@ -141,6 +191,65 @@ class Checkpointer:
                 step, args=ocp.args.Composite(
                     state=ocp.args.StandardRestore(abstract)))["state"]
         return self._mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+
+    def restore(self, target: PyTree, step: int | None = None) -> PyTree:
+        """Restore into the shardings of ``target``.
+
+        ``target`` may be a concrete sharded TrainState (its leaves' shardings
+        are reused — the restore-if-exists moment of ``ChiefSessionCreator``)
+        or a pytree of ShapeDtypeStruct with shardings. The shardings may
+        belong to a DIFFERENT mesh than the one that saved: Orbax reshards
+        on read, which is the whole elastic-resume story
+        (``fault/elastic.py``, docs/RESILIENCE.md).
+
+        With ``step=None`` (the relaunch path) a corrupt/truncated newest
+        checkpoint is not fatal: restore WARNs and falls back to the next
+        older step, crashing only when every step on disk is unreadable.
+        An explicitly requested step gets no fallback — the caller asked
+        for exactly that step.
+        """
+        if step is not None:
+            return self._restore_one(target, step)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        last_err: Exception | None = None
+        for i, s in enumerate(steps):
+            try:
+                restored = self._restore_one(target, s)
+            except Exception as e:  # noqa: BLE001 — ANY unreadable-step
+                # class (truncated arrays, garbage metadata, missing
+                # files) must fall back, not crash the relaunch
+                if _looks_structural(e):
+                    # a WRONG RESTORE TARGET (tree-structure mismatch: the
+                    # relaunch built state for a different model config)
+                    # would fail identically against every step — falling
+                    # back would bury the misconfiguration under a bogus
+                    # "all checkpoints corrupt" story. Re-raise it as
+                    # itself, immediately.
+                    raise
+                last_err = e
+                older = steps[i + 1] if i + 1 < len(steps) else None
+                log.warning(
+                    "checkpoint step %d at %s is unreadable (%s: %.200s); "
+                    "falling back to %s", s, self.directory,
+                    type(e).__name__, e,
+                    f"step {older}" if older is not None
+                    else "nothing — no older step")
+                continue
+            if s != steps[0]:
+                log.warning(
+                    "resumed from step %d instead of the newest step %d "
+                    "(unreadable); training will redo the difference", s,
+                    steps[0])
+            self._last_restored_step = s
+            return restored
+        raise RuntimeError(
+            f"every checkpoint step under {self.directory} is unreadable "
+            f"(tried {steps}) — corrupt files, or a restore target whose "
+            f"mismatch this guard didn't recognize; last error: "
+            f"{type(last_err).__name__}: {last_err}")
 
     def restore_raw(self, step: int | None = None) -> PyTree:
         """Restore exactly as saved, no target tree required.
@@ -201,11 +310,16 @@ class Checkpointer:
         return raw["params"]
 
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:
-        """(state, restored_step) — state unchanged if nothing on disk."""
-        step = self._mgr.latest_step()
-        if step is None:
+        """(state, restored_step) — state unchanged if nothing on disk.
+
+        Rides :meth:`restore`'s guarded latest-step path: a corrupt newest
+        checkpoint falls back to an older readable step (WARN), and
+        ``restored_step`` reports the step actually loaded.
+        """
+        if self._mgr.latest_step() is None:
             return target, None
-        return self.restore(target, step), step
+        restored = self.restore(target, None)
+        return restored, self._last_restored_step
 
     def wait(self) -> None:
         """Block until pending async saves are durable."""

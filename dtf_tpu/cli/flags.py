@@ -31,6 +31,13 @@ def define_cluster_flags():
                          "synchronously")
     flags.DEFINE_string("backend", "tpu", "tpu | cpu (cpu = simulated mesh "
                         "for local testing)")
+    flags.DEFINE_integer(
+        "devices_per_host", 0,
+        "fake-hosts harness (cpu multi-worker launches): each host's share "
+        "of the simulated mesh — the cluster mesh spans devices_per_host x "
+        "n_workers devices, so a relaunch with fewer workers re-forms a "
+        "SMALLER mesh and resumes by resharding (docs/RESILIENCE.md). "
+        "0 = all local devices (single-process behavior).")
 
 
 def define_mesh_flags():

@@ -25,6 +25,13 @@ def setup(FLAGS):
     Returns ``(mesh, info)``. For ``--job_name=ps`` this exits the process
     with status 0 — the TPU-native successor of ``server.join()`` (the PS
     role's state lives sharded on the mesh; the process has nothing to do).
+
+    Multi-worker launches are CHIP-GATED (``dist.initialize_or_fake``):
+    true ``jax.distributed.initialize`` on the tpu backend, the fake-hosts
+    harness on cpu (this jaxlib refuses cross-process CPU collectives —
+    docs/RESILIENCE.md). In fake mode ``--devices_per_host`` sizes each
+    host's share of the simulated mesh, so an elastic relaunch with fewer
+    workers re-forms a smaller mesh and resumes by resharding.
     """
     info = dist.collapse_cluster_flags(
         ps_hosts=[h for h in FLAGS.ps_hosts.split(",") if h],
@@ -44,14 +51,65 @@ def setup(FLAGS):
     if FLAGS.backend == "cpu":
         # Local-sim path: the test/dev equivalent of a multi-worker cluster.
         jax.config.update("jax_platforms", "cpu")
-    dist.initialize(info)
+    info = dist.initialize_or_fake(info, FLAGS.backend)
+    devices = None
+    dph = getattr(FLAGS, "devices_per_host", 0)
+    # cpu only (a real chip's devices are what they are): sizes the
+    # simulated cluster — including the 1-worker SURVIVOR relaunch after
+    # an elastic shrink, whose mesh must span dph x 1 devices, not every
+    # local device.
+    if dph and FLAGS.backend == "cpu":
+        want = dph * info.num_processes
+        have = len(jax.devices())
+        if want > have:
+            raise ValueError(
+                f"--devices_per_host={dph} x {info.num_processes} workers "
+                f"= {want} mesh devices, but only {have} simulated devices "
+                f"exist (raise --xla_force_host_platform_device_count)")
+        devices = jax.devices()[:want]
     mesh = make_mesh(MeshConfig(
         data=FLAGS.mesh_data, seq=FLAGS.mesh_seq, model=FLAGS.mesh_model,
-        pipe=FLAGS.mesh_pipe, expert=FLAGS.mesh_expert))
+        pipe=FLAGS.mesh_pipe, expert=FLAGS.mesh_expert), devices=devices)
+    if info.num_processes > 1:
+        from dtf_tpu.core.mesh import assert_host_aligned
+
+        assert_host_aligned(mesh, info.num_processes)
     if info.is_chief:
-        log.info("%s | %d process(es), chief=%s",
-                 mesh_summary(mesh), info.num_processes, info.is_chief)
+        log.info("%s | %d process(es), chief=%s fake_hosts=%s",
+                 mesh_summary(mesh), info.num_processes, info.is_chief,
+                 info.fake_hosts)
     return mesh, info
+
+
+def host_batches(info, mesh, make_loader):
+    """The one data-dispatch for every launch shape.
+
+    ``make_loader(host_index=, host_count=)`` builds one host's loader
+    (the kwargs every array loader and ``SyntheticData`` already takes).
+    Returns ``(batches, place_batch)`` for the Trainer:
+
+    - single process        → one global loader, default placement;
+    - real multi-process    → this process's 1/N loader,
+      ``comms.host_local_to_global`` placement (each host contributes its
+      addressable shards);
+    - fake hosts (cpu sim)  → a ``FakeHostStream`` over ALL N per-host
+      loaders + ``comms.fake_hosts_to_global`` placement — the same
+      disjoint-rows contract, exercised end to end inside one process.
+    """
+    from dtf_tpu.core.comms import fake_hosts_to_global, host_local_to_global
+    from dtf_tpu.core.mesh import host_views
+    from dtf_tpu.data.sharded import FakeHostStream, loaders_for_hosts
+
+    if info.num_processes <= 1:
+        return iter(make_loader(host_index=0, host_count=1)), None
+    if info.fake_hosts:
+        loaders = loaders_for_hosts(make_loader,
+                                    host_views(info.num_processes))
+        return (iter(FakeHostStream(loaders)),
+                lambda hb: fake_hosts_to_global(hb, mesh))
+    loader = make_loader(host_index=info.process_id,
+                         host_count=info.num_processes)
+    return iter(loader), lambda b: host_local_to_global(b, mesh)
 
 
 def lm_eval_hook(FLAGS, info, mesh, shardings, eval_fn, writer, place_batch,

@@ -389,3 +389,69 @@ class TpDense(nn.Module):
                 if self.use_bias else None)
         return tp_dense(x, kernel, bias, self.mesh, parallel=self.parallel,
                         overlap=self.overlap, dtype=self.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Fake-N-hosts batch assembly (the elastic-harness data seam).
+# ---------------------------------------------------------------------------
+
+def fake_hosts_to_global(host_batches: Sequence[PyTree], mesh: Mesh,
+                         *, batch_dim: int = 0,
+                         spec: P | None = None) -> PyTree:
+    """Single-process stand-in for :func:`host_local_to_global`.
+
+    ``host_batches[k]`` is fake host ``k``'s host-local batch (disjoint
+    global rows, the loaders' ``host_index/host_count`` contract). Each
+    leaf is assembled into ONE global sharded array by placing every
+    device's shard from *the owning host's local array only* — the exact
+    data motion N real processes perform, minus the coordination service.
+
+    The per-device ownership check is the harness's proof obligation: a
+    device whose batch rows straddle two hosts' local arrays would be
+    impossible to feed in a real multi-host run (host k cannot place rows
+    on host j's devices), so it raises here instead of silently reading
+    across the boundary. ``mesh.shape['data'] % len(host_batches) == 0``
+    makes it unreachable (``mesh.assert_host_aligned``).
+
+    Shardings match :func:`shard_batch`'s exactly (same
+    ``batch_sharding`` spec path), so a train step compiled against
+    single-process placement accepts these arrays without a retrace.
+    """
+    n_hosts = len(host_batches)
+    if not n_hosts:
+        raise ValueError("need at least one host batch")
+
+    def leaf(*xs):
+        xs = [np.asarray(x) for x in xs]
+        local_rows = xs[0].shape[batch_dim]
+        for k, x in enumerate(xs):
+            if x.shape[batch_dim] != local_rows:
+                raise ValueError(
+                    f"host {k} local batch has {x.shape[batch_dim]} rows, "
+                    f"host 0 has {local_rows} — hosts must feed equal "
+                    f"shares of the global batch")
+        gshape = list(xs[0].shape)
+        gshape[batch_dim] = local_rows * n_hosts
+        gshape = tuple(gshape)
+        s = spec
+        if s is not None and xs[0].ndim < len(s):
+            s = P(*list(s)[: xs[0].ndim])
+        sh = batch_sharding(mesh, batch_dim=batch_dim, spec=s)
+        shards = []
+        for dev, idx in sh.devices_indices_map(gshape).items():
+            rows = idx[batch_dim]
+            start = 0 if rows.start is None else rows.start
+            stop = gshape[batch_dim] if rows.stop is None else rows.stop
+            host, off = divmod(start, local_rows)
+            if stop - start > local_rows - off:
+                raise ValueError(
+                    f"device {dev} batch rows [{start}:{stop}) straddle "
+                    f"the host boundary at {(host + 1) * local_rows} — "
+                    f"data axis {mesh.shape.get('data', 1)} is not "
+                    f"divisible across {n_hosts} hosts")
+            local_idx = list(idx)
+            local_idx[batch_dim] = slice(off, off + (stop - start))
+            shards.append(jax.device_put(xs[host][tuple(local_idx)], dev))
+        return jax.make_array_from_single_device_arrays(gshape, sh, shards)
+
+    return jax.tree.map(leaf, *host_batches)

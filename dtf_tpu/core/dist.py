@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 from typing import Sequence
 
 import jax
@@ -35,7 +36,41 @@ class ClusterInfo:
     #: True for --job_name=ps: this process has no role on the TPU backend
     #: (the ``server.join()`` successor is "exit 0 immediately").
     should_exit: bool = False
+    #: True when a multi-process launch was collapsed onto the FAKE-hosts
+    #: harness: this container's jaxlib refuses multi-process CPU
+    #: collectives (docs/RESILIENCE.md), so on the cpu backend every
+    #: worker process runs the full deterministic SPMD program over its
+    #: own simulated mesh, host identity driving only data sharding
+    #: (``core.mesh.HostView``), chief-ness and checkpoint ownership.
+    #: The chip path keeps true ``jax.distributed.initialize``.
+    fake_hosts: bool = False
     notes: tuple[str, ...] = ()
+
+    @property
+    def host_count(self) -> int:
+        """Hosts feeding the input pipeline (== num_processes; spelled
+        separately so call sites say which concept they mean)."""
+        return self.num_processes
+
+    @property
+    def participates_in_save(self) -> bool:
+        """Whether this process takes part in checkpoint writes.
+
+        Real multi-host: every process (Orbax sharded saves are
+        collective — each host writes its addressable shards). Fake
+        hosts: the chief only — every worker holds the FULL state, and
+        N processes writing one checkpoint dir would race.
+        """
+        return self.is_chief or not self.fake_hosts
+
+    def local_host_ids(self) -> tuple[int, int]:
+        """(host_index, host_count) for loaders that feed THIS process's
+        addressable data only — e.g. the eval sweep. Fake hosts hold the
+        whole mesh, so they read the full split; real processes read
+        their 1/N shard."""
+        if self.fake_hosts:
+            return 0, 1
+        return self.process_id, self.num_processes
 
 
 def collapse_cluster_flags(
@@ -113,3 +148,53 @@ def initialize(info: ClusterInfo) -> None:
 
 def is_chief() -> bool:
     return jax.process_index() == 0
+
+
+#: escape hatch for the chip-gated multi-process tests: set to "1" to force
+#: true ``jax.distributed.initialize`` on any backend (the slow-tier
+#: cross-process tests export it when a platform that CAN run multi-process
+#: collectives is attached).
+FORCE_REAL_MULTIPROCESS_ENV = "DTF_REAL_MULTIPROCESS"
+
+
+def multiprocess_collectives_supported(platform: str) -> bool:
+    """Whether this launch may use true multi-process collectives.
+
+    The known blocker (PR 8 note, docs/RESILIENCE.md): this container's
+    jaxlib refuses cross-process collectives on the CPU backend — the
+    first collective hangs in the Gloo rendezvous. TPU backends (and any
+    environment that sets ``DTF_REAL_MULTIPROCESS=1`` to vouch for its
+    jaxlib) take the real ``jax.distributed.initialize`` path; cpu
+    multi-worker launches collapse onto the fake-hosts harness instead.
+    """
+    if os.environ.get(FORCE_REAL_MULTIPROCESS_ENV) == "1":
+        return True
+    return platform not in ("cpu",)
+
+
+def initialize_or_fake(info: ClusterInfo, platform: str) -> ClusterInfo:
+    """The launchers' bootstrap: real distributed init on the chip path,
+    the fake-hosts collapse where multi-process collectives cannot work.
+
+    Returns the (possibly updated) ClusterInfo; with ``fake_hosts=True``
+    the caller must feed data through the per-host harness
+    (``cli.launch.host_batches``) and gate checkpoint writes on
+    ``info.participates_in_save``. Single-process launches pass through
+    untouched either way.
+    """
+    if info.num_processes <= 1 or info.should_exit:
+        return info
+    if multiprocess_collectives_supported(platform):
+        initialize(info)
+        return info
+    log.warning(
+        "multi-process launch on the %s backend: this jaxlib refuses "
+        "cross-process CPU collectives (docs/RESILIENCE.md), so the %d "
+        "workers run the fake-hosts harness — each process trains the "
+        "full deterministic SPMD program on its own simulated mesh, host "
+        "identity drives data sharding only, and the chief (process %d) "
+        "owns the checkpoint dir. True multi-process launch engages on "
+        "the tpu backend (or %s=1).",
+        platform, info.num_processes,
+        0, FORCE_REAL_MULTIPROCESS_ENV)
+    return dataclasses.replace(info, fake_hosts=True)

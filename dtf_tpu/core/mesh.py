@@ -111,3 +111,98 @@ def mesh_summary(mesh: Mesh) -> str:
     n = math.prod(mesh.devices.shape)
     plat = mesh.devices.flat[0].platform
     return f"mesh[{plat}x{n}] " + " ".join(f"{k}={v}" for k, v in sizes.items())
+
+
+# ---------------------------------------------------------------------------
+# Host identity over a process-spanning mesh (the elastic/multi-host seam).
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class HostView:
+    """Host ``k`` of ``N`` over one global mesh.
+
+    On a real pod this is simply ``(jax.process_index(), jax.process_count())``
+    (:func:`host_view_for_process`) and the devices it owns are the
+    process-addressable ones. On the CPU sim — where this container's jaxlib
+    refuses multi-process collectives (docs/RESILIENCE.md) — a single process
+    holds ALL devices and a ``HostView`` makes it *behave* as host ``k`` for
+    the two things host identity actually controls: which rows of the global
+    batch this host produces (:meth:`batch_rows`, driving the per-host data
+    loaders) and which mesh devices count as addressable
+    (:meth:`addressable_devices`, driving shard placement in
+    ``comms.fake_hosts_to_global``). That makes every elastic code path —
+    per-host sharding, shrink-resume, the run controller — testable in tier-1
+    with zero cross-process collectives.
+    """
+
+    host_index: int
+    host_count: int
+
+    def __post_init__(self):
+        if self.host_count < 1:
+            raise ValueError(f"host_count must be >= 1, got {self.host_count}")
+        if not (0 <= self.host_index < self.host_count):
+            raise ValueError(
+                f"host_index {self.host_index} out of range for "
+                f"{self.host_count} hosts")
+
+    def addressable_devices(self, mesh: Mesh) -> list:
+        """The contiguous device block host ``k`` owns.
+
+        ``data`` is the slowest-varying mesh axis (AXES), so splitting the
+        flattened device array into ``host_count`` equal blocks gives each
+        host whole data shards — the TPU reality (a host owns a contiguous
+        slice of the pod) and the precondition for per-host batch rows to
+        land only on that host's devices.
+        """
+        flat = list(mesh.devices.flat)
+        per = divmod(len(flat), self.host_count)
+        if per[1]:
+            raise ValueError(
+                f"{len(flat)} mesh devices not divisible across "
+                f"{self.host_count} hosts")
+        n = per[0]
+        return flat[self.host_index * n:(self.host_index + 1) * n]
+
+    def batch_rows(self, global_rows: int) -> tuple[int, int]:
+        """[start, stop) of the global-batch rows this host produces.
+
+        Matches the loaders' ``local_batch = global // host_count``
+        contract AND the mesh placement: with the data axis divisible by
+        ``host_count``, these rows shard exactly onto this host's devices.
+        """
+        if global_rows % self.host_count:
+            raise ValueError(
+                f"global batch {global_rows} not divisible by "
+                f"{self.host_count} hosts")
+        n = global_rows // self.host_count
+        return self.host_index * n, (self.host_index + 1) * n
+
+
+def host_views(host_count: int) -> list[HostView]:
+    """All N fake-host identities of one simulated cluster."""
+    return [HostView(k, host_count) for k in range(host_count)]
+
+
+def host_view_for_process() -> HostView:
+    """This process's REAL host identity (the chip path's HostView)."""
+    return HostView(jax.process_index(), jax.process_count())
+
+
+def assert_host_aligned(mesh: Mesh, host_count: int) -> None:
+    """Fail fast when a mesh cannot be split across ``host_count`` hosts.
+
+    Per-host data feeding requires every host to own whole ``data`` shards:
+    the data axis AND the flattened device count must both divide by the
+    host count (a data shard spanning two hosts would need one host's rows
+    placed on another host's devices — exactly what multi-host cannot do).
+    """
+    n = math.prod(mesh.devices.shape)
+    data = mesh.shape.get(AXIS_DATA, 1)
+    if n % host_count:
+        raise ValueError(
+            f"{n} mesh devices not divisible across {host_count} hosts")
+    if data % host_count:
+        raise ValueError(
+            f"data axis {data} not divisible across {host_count} hosts — "
+            f"per-host batch rows would straddle a host boundary")

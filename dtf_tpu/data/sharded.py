@@ -62,3 +62,45 @@ class ShardedEpochs:
                            self.local_batch):
                 yield shard[i:i + self.local_batch]
             epoch += 1
+
+
+# ---------------------------------------------------------------------------
+# Fake-N-hosts input feeding (the elastic-harness data seam).
+# ---------------------------------------------------------------------------
+
+def loaders_for_hosts(make_loader, views) -> list:
+    """One per-host loader per :class:`dtf_tpu.core.mesh.HostView`.
+
+    ``make_loader(host_index=, host_count=)`` is the loader constructor
+    partial every launcher already has (all array loaders and
+    ``SyntheticData`` take exactly these two kwargs); each returned loader
+    yields that host's disjoint row shard of the global batch.
+    """
+    return [make_loader(host_index=v.host_index, host_count=v.host_count)
+            for v in views]
+
+
+class FakeHostStream:
+    """Zip N per-host loaders into an iterator of per-host batch lists.
+
+    One item = ``[host 0's local batch, ..., host N-1's local batch]`` —
+    exactly the shape :func:`dtf_tpu.core.comms.fake_hosts_to_global`
+    assembles onto the mesh (pass that as the Trainer's ``place_batch``).
+    The single-process fake-cluster worker iterates this instead of one
+    global loader, so the per-host sharding contract (disjoint rows,
+    equal shares, host-aligned placement) is exercised on every step of a
+    CPU-sim run, not just in the real multi-process launch.
+    """
+
+    def __init__(self, loaders):
+        if not loaders:
+            raise ValueError("need at least one per-host loader")
+        self.loaders = list(loaders)
+
+    def __iter__(self) -> Iterator[list]:
+        its = [iter(ld) for ld in self.loaders]
+        while True:
+            try:
+                yield [next(it) for it in its]
+            except StopIteration:
+                return

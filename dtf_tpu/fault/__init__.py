@@ -1,0 +1,36 @@
+"""Elastic fault tolerance: run controller, fault injection, shrink-resume.
+
+The chief-side half of pod-scale robustness (ROADMAP 2, docs/RESILIENCE.md):
+
+- :mod:`~dtf_tpu.fault.controller` — the run controller state machine that
+  supervises host processes, distinguishes *host-lost* (relaunch smaller,
+  bounded exponential backoff) from *run-wedged* (stall watchdog fired with
+  every host alive → dump postmortems, kill, relaunch same size), and stamps
+  MTTR/restart counts into TELEMETRY.json.
+- :mod:`~dtf_tpu.fault.inject` — the fault-injection harness: kill a host at
+  a seeded step, deliver SIGTERM mid-checkpoint, wedge a step, corrupt the
+  newest checkpoint. Drives the REAL launchers via ``DTF_FAULT_INJECT``.
+- :mod:`~dtf_tpu.fault.elastic` — survivor-mesh arithmetic and the
+  resharding resume helper (ZeRO-1 shards re-partitioned by Orbax onto the
+  smaller mesh — a layout change, not a format change; docs/ZERO.md).
+
+Like ``telemetry/`` and ``tune/``, this package is **jax-free at module
+level** (srclint-fenced): the controller runs in a clean chief process that
+must never be able to hang on a wedged backend import; anything needing a
+backend imports it lazily inside the function that needs it.
+"""
+
+from dtf_tpu.fault.controller import (ControllerConfig, ControllerPolicy,
+                                      Decision, HostObservation,
+                                      RunController, read_heartbeat)
+from dtf_tpu.fault.elastic import (resume_state, survivor_host_count,
+                                   survivor_mesh_shape)
+from dtf_tpu.fault.inject import (FaultHook, FaultPlan,
+                                  corrupt_latest_checkpoint, maybe_hook)
+
+__all__ = [
+    "ControllerConfig", "ControllerPolicy", "Decision", "HostObservation",
+    "RunController", "read_heartbeat", "FaultHook", "FaultPlan",
+    "corrupt_latest_checkpoint", "maybe_hook", "resume_state",
+    "survivor_host_count", "survivor_mesh_shape",
+]

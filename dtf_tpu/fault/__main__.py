@@ -1,0 +1,101 @@
+"""``python -m dtf_tpu.fault`` — run a command fleet under the controller.
+
+    python -m dtf_tpu.fault --hosts=2 --logdir=/tmp/run \\
+        --max-restarts=3 --valid-hosts=1,2 -- \\
+        python scripts/distributed.py --backend=cpu --logdir=/tmp/run \\
+            --worker_hosts={worker_hosts} --task_index={host} \\
+            --devices_per_host=4 --telemetry
+
+The command after ``--`` is a template launched once per host with
+``{host}`` (this host's index), ``{hosts}`` (current host count) and
+``{worker_hosts}`` (a synthesized ``h0,h1,...`` list of the right length)
+substituted — on relaunch after a host loss the count shrinks, so the
+workers re-form a smaller mesh and resume by resharding (docs/RESILIENCE.md).
+
+Output: controller transition JSON lines, then the summary as the LAST line
+(the bench.py contract). Exit 0 on ``final: done``, 1 otherwise. jax-free —
+this process must never be able to hang on a wedged backend.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+from dtf_tpu.fault.controller import ControllerConfig, RunController
+from dtf_tpu.fault.inject import ENV_VAR as _FAULT_ENV
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if "--" not in argv:
+        print(json.dumps({"ok": False,
+                          "error": "usage: python -m dtf_tpu.fault "
+                                   "[options] -- <command template>"}))
+        return 2
+    split = argv.index("--")
+    template = argv[split + 1:]
+    parser = argparse.ArgumentParser(prog="python -m dtf_tpu.fault")
+    parser.add_argument("--hosts", type=int, required=True)
+    parser.add_argument("--logdir", required=True)
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--backoff-base-s", type=float, default=1.0)
+    parser.add_argument("--backoff-max-s", type=float, default=60.0)
+    parser.add_argument("--wedge-timeout-s", type=float, default=120.0)
+    parser.add_argument("--startup-timeout-s", type=float, default=600.0)
+    parser.add_argument("--grace-s", type=float, default=15.0)
+    parser.add_argument("--valid-hosts", default="",
+                        help="comma-separated allowed host counts "
+                             "(default: any >= 1); mesh divisibility — "
+                             "pre-price with `analysis fit --hosts --lost`")
+    parser.add_argument("--telemetry-artifact", default="",
+                        help="merge the MTTR/restart summary into this "
+                             "TELEMETRY.json")
+    args = parser.parse_args(argv[:split])
+    if not template:
+        print(json.dumps({"ok": False, "error": "empty command template"}))
+        return 2
+
+    valid = None
+    if args.valid_hosts:
+        allowed = {int(x) for x in args.valid_hosts.split(",") if x}
+        valid = allowed.__contains__
+
+    def launch(n_hosts: int, attempt: int) -> list:
+        worker_hosts = ",".join(f"host{i}" for i in range(n_hosts))
+        env = dict(os.environ)
+        if attempt > 0:
+            # an injected fault is a one-shot scenario: FaultHook fires at
+            # step >= plan.step, and a relaunch resumes from a checkpoint
+            # that can be PAST it — re-tripping the same fault every
+            # generation would turn a recoverable kill/wedge into a
+            # max-restarts exhaustion
+            env.pop(_FAULT_ENV, None)
+        procs = []
+        for host in range(n_hosts):
+            cmd = [t.format(host=host, hosts=n_hosts,
+                            worker_hosts=worker_hosts) for t in template]
+            procs.append(subprocess.Popen(cmd, env=env))
+        return procs
+
+    ctl = RunController(
+        launch, args.hosts, args.logdir,
+        ControllerConfig(
+            max_restarts=args.max_restarts,
+            backoff_base_s=args.backoff_base_s,
+            backoff_max_s=args.backoff_max_s,
+            wedge_timeout_s=args.wedge_timeout_s,
+            startup_timeout_s=args.startup_timeout_s,
+            grace_s=args.grace_s),
+        valid_hosts=valid)
+    summary = ctl.run()
+    ctl.finish(summary, args.telemetry_artifact or None)
+    print(json.dumps(summary))
+    return 0 if summary.get("final") == "done" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,229 @@
+"""Fault injection — seeded, reproducible failures against the REAL stack.
+
+The MultiProcessRunner lineage (SURVEY.md §4) taught one lesson: recovery
+paths that are not exercised do not work. This module injects the four
+failure classes the run controller must survive, each at a *seeded step* so
+every scenario is deterministic and its recovery assertable:
+
+- ``kill@S``            — SIGKILL this host at step S (host-lost: no dump,
+                          no save — the relaunch resumes from the last
+                          periodic checkpoint on a smaller mesh).
+- ``wedge@S``           — stop completing steps at S while staying alive
+                          (run-wedged: the stall watchdog flags the
+                          heartbeat; the controller kills and relaunches
+                          at the same size).
+- ``sigterm@S``         — deliver SIGTERM at the step-S boundary (graceful
+                          preemption: dump → save → clean exit).
+- ``sigterm_in_save@S`` — deliver SIGTERM from INSIDE ``Checkpointer.save``
+                          at step S (the hard case: the flight recorder's
+                          dump handler runs between the save's bytecodes —
+                          the RLock/dump-first contracts from PR 5/8, end
+                          to end).
+- ``crash@S``           — raise at step S (in-process twin of ``kill`` for
+                          tier-1 tests that cannot SIGKILL the test
+                          runner; exercises the crash-postmortem path).
+
+Plans ride the environment (``DTF_FAULT_INJECT="kill@12:host=1"``) so the
+subprocess scenarios drive the real CLI entrypoints unmodified; ``host=``
+scopes the fault to one fake host of the cluster sim.
+:func:`corrupt_latest_checkpoint` is the offline fifth scenario: damage the
+newest checkpoint so the relaunch must fall back a step (WARN, not crash —
+``Checkpointer.restore``'s guarded path).
+
+jax-free at module level (srclint-fenced) — injection is pure host/OS work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import signal
+import time
+from typing import Mapping, Optional
+
+ENV_VAR = "DTF_FAULT_INJECT"
+
+KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash")
+
+
+class InjectedCrash(RuntimeError):
+    """The ``crash@S`` payload — a host died, in exception form."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """One seeded fault: ``<kind>@<step>[:host=<k>]``."""
+
+    kind: str
+    step: int
+    host: Optional[int] = None     # None = every host
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(
+                f"unknown fault kind {self.kind!r}; have {KINDS}")
+        if self.step < 0:
+            raise ValueError(f"fault step must be >= 0, got {self.step}")
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        body, _, tail = spec.strip().partition(":")
+        kind, at, step = body.partition("@")
+        if not at:
+            raise ValueError(
+                f"fault spec {spec!r} needs '<kind>@<step>'")
+        host = None
+        if tail:
+            key, _, val = tail.partition("=")
+            if key != "host":
+                raise ValueError(
+                    f"unknown fault option {key!r} in {spec!r}")
+            host = int(val)
+        return cls(kind=kind, step=int(step), host=host)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping] = None) -> Optional["FaultPlan"]:
+        spec = (env if env is not None else os.environ).get(ENV_VAR, "")
+        return cls.parse(spec) if spec else None
+
+    def applies_to(self, host_index: int) -> bool:
+        return self.host is None or self.host == host_index
+
+
+class FaultHook:
+    """Trainer hook that executes a :class:`FaultPlan` at its seeded step.
+
+    Duck-typed against :class:`dtf_tpu.hooks.Hook` (no jax import). Place
+    it FIRST in the hook list: the injected SIGTERM must land before
+    PreemptionHook's ``after_step`` runs at the same boundary, so the hook
+    saves the exact seeded step. ``checkpointer`` is required for
+    ``sigterm_in_save`` (its ``save`` is wrapped so the signal arrives
+    mid-write). Each firing prints one JSON line first — a scenario whose
+    recovery assertion fails must still show WHERE the fault landed.
+    """
+
+    telemetry_bucket = "hooks"
+
+    #: wedge sleep quantum — short enough that SIGKILL tests reap quickly
+    WEDGE_POLL_S = 0.5
+
+    def __init__(self, plan: FaultPlan, *, host_index: int = 0,
+                 checkpointer=None, emit=None):
+        self.plan = plan
+        self.host_index = host_index
+        self.ckpt = checkpointer
+        self._emit = emit or (lambda line: print(line, flush=True))
+        self.fired = False
+        if (plan.kind == "sigterm_in_save" and checkpointer is not None
+                and plan.applies_to(host_index)):
+            self._wrap_save(checkpointer)
+
+    def _note(self, what: str) -> None:
+        try:
+            self._emit(json.dumps({
+                "fault_inject": what, "kind": self.plan.kind,
+                "step": self.plan.step, "host": self.host_index,
+                "pid": os.getpid(), "t": round(time.time(), 3)}))
+        except Exception:   # noqa: BLE001 — injection reporting must not
+            pass            # alter the scenario under test
+
+    def _wrap_save(self, ckpt) -> None:
+        orig = ckpt.save
+        plan = self.plan
+
+        def save(step, state, **kw):
+            if not self.fired and step >= plan.step:
+                self.fired = True
+                self._note("sigterm_in_save")
+                # handled at the next bytecode boundary: the telemetry
+                # dump + PreemptionHook flag run INSIDE this save call
+                os.kill(os.getpid(), signal.SIGTERM)
+            return orig(step, state, **kw)
+
+        ckpt.save = save
+
+    # ------------------------------------------------------- hook lifecycle
+
+    def begin(self, state) -> None: ...
+
+    def before_step(self, step: int) -> None: ...
+
+    def after_step(self, step: int, state, metrics) -> None:
+        plan = self.plan
+        if (self.fired or plan.kind == "sigterm_in_save"
+                or not plan.applies_to(self.host_index)
+                or step < plan.step):
+            return
+        self.fired = True
+        self._note("firing")
+        if plan.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif plan.kind == "sigterm":
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif plan.kind == "crash":
+            raise InjectedCrash(
+                f"injected crash at step {step} (host {self.host_index})")
+        elif plan.kind == "wedge":
+            # alive but never completing another step: SIGTERM only sets
+            # the PreemptionHook flag (checked at a boundary this loop
+            # will never reach again), so like a real wedge it takes the
+            # controller's SIGKILL to clear — sleep in short quanta so
+            # the process stays signal-responsive for the dump chain.
+            while True:
+                time.sleep(self.WEDGE_POLL_S)
+
+    def end(self, state) -> None: ...
+
+
+def maybe_hook(*, host_index: int = 0, checkpointer=None,
+               env: Optional[Mapping] = None) -> Optional[FaultHook]:
+    """The launchers' one-liner: a FaultHook when ``DTF_FAULT_INJECT`` is
+    set and targets this host, else None."""
+    plan = FaultPlan.from_env(env)
+    if plan is None or not plan.applies_to(host_index):
+        return None
+    return FaultHook(plan, host_index=host_index, checkpointer=checkpointer)
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint corruption (the restore-fallback scenario).
+# ---------------------------------------------------------------------------
+
+def corrupt_latest_checkpoint(ckpt_dir: str, *, mode: str = "truncate",
+                              min_bytes: int = 1) -> dict:
+    """Damage the newest checkpoint step so restore must fall back.
+
+    ``truncate`` halves every data file in the step dir (a host died
+    mid-write after the atomic rename — rare but real on network
+    filesystems); ``garbage`` overwrites their heads. Orbax's own
+    atomicity makes a *cleanly interrupted* save invisible, so this
+    simulates the uglier post-commit damage class. Returns
+    ``{"step": n, "files": [...]}``; raises FileNotFoundError when no
+    step dir exists (a scenario that corrupts nothing is not testing the
+    fallback).
+    """
+    if mode not in ("truncate", "garbage"):
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    steps = [int(d) for d in os.listdir(ckpt_dir) if d.isdigit()]
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint steps under {ckpt_dir}")
+    step = max(steps)
+    touched = []
+    for root, _, files in os.walk(os.path.join(ckpt_dir, str(step))):
+        for name in files:
+            path = os.path.join(root, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size < min_bytes:
+                continue
+            if mode == "truncate":
+                with open(path, "r+b") as f:
+                    f.truncate(size // 2)
+            else:
+                with open(path, "r+b") as f:
+                    f.write(b"\xde\xad\xbe\xef" * 4)
+            touched.append(os.path.relpath(path, ckpt_dir))
+    return {"step": step, "files": sorted(touched)}

@@ -188,17 +188,32 @@ class PreemptionHook(Hook):
     # overhead would invert multi-host goodput
     telemetry_bucket = "preempt_sync"
 
-    def __init__(self, ckpt: Checkpointer, signals=(signal.SIGTERM,),
-                 check_every: int = 8):
+    def __init__(self, ckpt: Checkpointer | None, signals=(signal.SIGTERM,),
+                 check_every: int = 8, *, on_preempt=None,
+                 save_retries: int = 2, save_backoff_s: float = 0.25):
         #: multi-host flag-sync cadence: the OR-allgather is a device
         #: collective whose result the host blocks on, so syncing every
         #: step would forfeit async-dispatch run-ahead; every ``check_every``
         #: steps bounds the reaction delay (grace windows are ~30 s, steps
         #: are ms–s) while amortizing the barrier. Single-host runs react
         #: at the very next step regardless.
+        #:
+        #: ``ckpt=None``: stop cleanly on SIGTERM without saving — the
+        #: non-chief fake-host processes of a CPU-sim cluster (the chief
+        #: owns the shared checkpoint dir; docs/RESILIENCE.md).
+        #: ``on_preempt(step)``: controller notification, called AFTER the
+        #: save is durable (the last link of the SIGTERM chain: flight
+        #: dump → checkpoint → notify); errors are swallowed — a broken
+        #: notifier must not undo a clean preemption exit.
+        #: ``save_retries``/``save_backoff_s``: Checkpointer.save_durable
+        #: knobs — a transient save failure inside the grace window
+        #: retries, then falls back to the previous checkpoint cleanly.
         self.ckpt = ckpt
         self.signals = tuple(signals)
         self.check_every = max(1, check_every)
+        self.on_preempt = on_preempt
+        self.save_retries = save_retries
+        self.save_backoff_s = save_backoff_s
         self.preempted = False
         self._prev: dict = {}
         self._multiprocess = False
@@ -224,8 +239,20 @@ class PreemptionHook(Hook):
             flag = bool(multihost_utils.process_allgather(
                 np.asarray([self.preempted])).any())
         if flag:
-            self.ckpt.save(step, state, force=True)
-            self.ckpt.wait()
+            saved = True
+            if self.ckpt is not None:
+                saved = self.ckpt.save_durable(
+                    step, state, retries=self.save_retries,
+                    backoff_s=self.save_backoff_s)
+            if saved and self.on_preempt is not None:
+                # notify ONLY after the save is durable: the marker means
+                # "step N is the resume point" — a failed save must not
+                # advertise a step that only exists on the older
+                # checkpoint (save_durable already logged the failure).
+                try:
+                    self.on_preempt(step)
+                except Exception:  # noqa: BLE001 — see __init__ docstring
+                    pass
             raise StopTraining
 
     def end(self, state):

@@ -53,9 +53,15 @@ class FlightRecorder:
     """
 
     def __init__(self, path: Optional[str] = None, *, keep: int = 64,
+                 heartbeat_path: Optional[str] = None,
                  clock=time.monotonic, wall=time.time):
         self.path = path
         self.keep = keep
+        #: liveness file for the elastic run controller (dtf_tpu/fault):
+        #: written atomically by the stall watchdog's poll thread — NOT by
+        #: the hot path — with the last completed step and the stalled
+        #: flag. None = no heartbeat (the default for bare recorders).
+        self.heartbeat_path = heartbeat_path
         self.clock = clock
         self.wall = wall
         self.records: collections.deque = collections.deque(maxlen=keep)
@@ -116,6 +122,32 @@ class FlightRecorder:
                 self._providers.pop(name, None)
             else:
                 self._providers[name] = fn
+
+    # ------------------------------------------------------------ heartbeat
+
+    def write_heartbeat(self, *, stalled: bool = False) -> None:
+        """One atomic liveness record (tmp + rename so the controller can
+        never read a torn write). Host facts only, never raises — it runs
+        on the watchdog thread against a possibly-wedged backend. A wedged
+        loop keeps heartbeating (the thread is alive) with ``stalled:
+        true`` and a frozen ``step`` — exactly the signature the
+        controller's run-wedged verdict keys on; a SIGKILL'd host simply
+        stops writing."""
+        path = self.heartbeat_path
+        if not path:
+            return
+        with self._lock:
+            step = self.records[-1]["step"] if self.records else None
+        rec = {"t": round(self.wall(), 3), "pid": os.getpid(),
+               "step": step, "stalled": bool(stalled)}
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(json.dumps(rec))
+            os.replace(tmp, path)
+        except OSError:
+            pass
 
     # ----------------------------------------------------------------- dump
 
@@ -215,14 +247,29 @@ class StallWatchdog:
 
     # ------------------------------------------------------------ lifecycle
 
+    def stalled_now(self) -> bool:
+        """True while the current stall episode is unresolved (fired and
+        no step has completed since)."""
+        return (self._fired_at is not None
+                and self._fired_at == self.flight.last_step_t)
+
     def start(self) -> None:
         if self._thread is not None:
             return
         self._stop.clear()
+        # first heartbeat BEFORE the first poll interval: the controller's
+        # startup-timeout clock stops the moment liveness appears, and
+        # compile time shouldn't eat into it
+        self.flight.write_heartbeat(stalled=False)
 
         def run():
             while not self._stop.wait(self.poll_s):
                 self.check()
+                # liveness every poll: a wedged loop keeps heartbeating
+                # with stalled=true (this thread is alive even when the
+                # main thread is stuck inside a device call); only a dead
+                # process goes silent
+                self.flight.write_heartbeat(stalled=self.stalled_now())
 
         self._thread = threading.Thread(
             target=run, name="dtf-stall-watchdog", daemon=True)

@@ -56,7 +56,14 @@ class Telemetry:
         self.goodput = GoodputTracker()
         self.flight = FlightRecorder(
             os.path.join(out_dir, "postmortem.json") if out_dir else None,
-            keep=keep_steps, clock=clock, wall=wall)
+            keep=keep_steps,
+            # liveness for the elastic run controller (dtf_tpu/fault):
+            # written by the watchdog thread, so it exists exactly when
+            # the stall detector runs — the two signals the host-lost vs
+            # run-wedged verdict needs come from one place
+            heartbeat_path=(os.path.join(out_dir, "heartbeat.json")
+                            if out_dir else None),
+            clock=clock, wall=wall)
         self.watchdog = StallWatchdog(
             self.flight, factor=stall_factor, min_stall_s=min_stall_s) \
             if watchdog else None

@@ -36,14 +36,19 @@ def main(argv):
     import logging
 
     logging.getLogger("dtf_tpu").setLevel(logging.INFO)
+    import json
+
     import jax
     import optax
 
     from dtf_tpu.checkpoint import Checkpointer
-    from dtf_tpu.cli.launch import profiler_hooks, setup
+    from dtf_tpu.cli.launch import (emit_run_report, host_batches,
+                                    profiler_hooks, setup,
+                                    telemetry_from_flags)
     from dtf_tpu.core import train as tr
     from dtf_tpu.data import mnist as mnist_data
     from dtf_tpu.data.synthetic import SyntheticData
+    from dtf_tpu.fault import inject
     from dtf_tpu.hooks import (CheckpointHook, LoggingHook,
                                PreemptionHook, StopAtStepHook)
     from dtf_tpu.loop import Trainer
@@ -51,6 +56,7 @@ def main(argv):
     from dtf_tpu.models import mnist as mnist_model
 
     mesh, info = setup(FLAGS)
+    tel = telemetry_from_flags(FLAGS, info)
 
     model = mnist_model.make_model(FLAGS.model)
     # GradientDescentOptimizer equivalent; the reference used plain SGD.
@@ -60,43 +66,73 @@ def main(argv):
         mnist_model.make_init(model), tx, jax.random.PRNGKey(FLAGS.seed),
         mesh)
     step = tr.make_train_step(mnist_model.make_loss(model), tx, mesh,
-                              shardings, grad_accum=FLAGS.grad_accum)
+                              shardings, grad_accum=FLAGS.grad_accum,
+                              telemetry=tel)
 
-    if FLAGS.data_dir and mnist_data.available(FLAGS.data_dir):
-        from dtf_tpu.data import native as native_io
+    def make_loader(*, host_index, host_count):
+        if FLAGS.data_dir and mnist_data.available(FLAGS.data_dir):
+            from dtf_tpu.data import native as native_io
 
-        img = os.path.join(FLAGS.data_dir, mnist_data.FILES["train_images"])
-        lab = os.path.join(FLAGS.data_dir, mnist_data.FILES["train_labels"])
-        if native_io.native_available() and os.path.exists(img) \
-                and os.path.exists(lab):
-            # C++ prefetching loader (queue-runner successor)
-            data = native_io.NativeIdxData(
-                img, lab, FLAGS.batch_size, seed=FLAGS.seed,
-                host_index=info.process_id, host_count=info.num_processes)
-        else:
-            data = mnist_data.MnistData(
+            img = os.path.join(FLAGS.data_dir,
+                               mnist_data.FILES["train_images"])
+            lab = os.path.join(FLAGS.data_dir,
+                               mnist_data.FILES["train_labels"])
+            if native_io.native_available() and os.path.exists(img) \
+                    and os.path.exists(lab):
+                # C++ prefetching loader (queue-runner successor)
+                return native_io.NativeIdxData(
+                    img, lab, FLAGS.batch_size, seed=FLAGS.seed,
+                    host_index=host_index, host_count=host_count)
+            return mnist_data.MnistData(
                 FLAGS.data_dir, FLAGS.batch_size, seed=FLAGS.seed,
-                host_index=info.process_id, host_count=info.num_processes)
-    else:
+                host_index=host_index, host_count=host_count)
         if FLAGS.data_dir:
             absl_logging.warning("MNIST files not found in %s; using "
                                  "synthetic data", FLAGS.data_dir)
-        data = SyntheticData(
+        return SyntheticData(
             "mnist", FLAGS.batch_size, seed=FLAGS.seed,
-            host_index=info.process_id, host_count=info.num_processes)
+            host_index=host_index, host_count=host_count)
+
+    # single / real-multi / fake-hosts dispatch (docs/RESILIENCE.md):
+    # fake mode feeds per-host disjoint shards through the HostView
+    # assembly so the multi-host data contract runs on the CPU sim too.
+    batches, place_batch = host_batches(info, mesh, make_loader)
 
     writer = MetricWriter(FLAGS.logdir if info.is_chief else None)
+    # fake hosts: only the chief owns the shared checkpoint dir (every
+    # worker holds the full state); real multi-host saves are collective.
     ckpt = Checkpointer(os.path.join(FLAGS.logdir, "ckpt"),
                         save_interval_steps=FLAGS.checkpoint_every)
-    trainer = Trainer(
-        step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched),
-               CheckpointHook(ckpt, FLAGS.checkpoint_every),
-               PreemptionHook(ckpt),
-               StopAtStepHook(FLAGS.train_steps),
-               *profiler_hooks(FLAGS)],
-        checkpointer=ckpt)
-    state = trainer.fit(state, iter(data))
+    save_ckpt = ckpt if info.participates_in_save else None
+
+    def on_preempt(step_):
+        # the SIGTERM chain's last link: flight dump happened in the
+        # telemetry handler, the checkpoint is durable — now tell the
+        # controller where the run stood (one host fact file).
+        marker = os.path.join(FLAGS.logdir, "telemetry",
+                              f"p{info.process_id}" if
+                              info.num_processes > 1 else "",
+                              "preempt.json")
+        os.makedirs(os.path.dirname(marker), exist_ok=True)
+        with open(marker, "w") as f:
+            json.dump({"step": int(step_), "host": info.process_id}, f)
+
+    hooks = [LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
+                         telemetry=tel)]
+    fault = inject.maybe_hook(host_index=info.process_id,
+                              checkpointer=save_ckpt)
+    if fault is not None:
+        hooks.insert(0, fault)   # injected faults land before save hooks
+    hooks += [CheckpointHook(save_ckpt, FLAGS.checkpoint_every)
+              ] if save_ckpt is not None else []
+    hooks += [PreemptionHook(save_ckpt, on_preempt=on_preempt),
+              StopAtStepHook(FLAGS.train_steps),
+              *profiler_hooks(FLAGS, telemetry=tel)]
+    trainer = Trainer(step, mesh, hooks=hooks, checkpointer=ckpt,
+                      place_batch=place_batch, telemetry=tel)
+    state = trainer.fit(state, batches)
+    emit_run_report(tel, info, extra={"workload": "mnist",
+                                      "fake_hosts": info.fake_hosts})
 
     # final eval (the reference's script printed test accuracy at the end):
     # real data → the FULL t10k test split, averaged over batches; synthetic
@@ -105,12 +141,18 @@ def main(argv):
 
     from dtf_tpu.core.comms import shard_batch
 
-    if isinstance(data, SyntheticData):
-        eval_batches = [data.batch(10_000_019)]
+    # fake hosts hold the whole mesh, so they read the full split locally
+    # (local_host_ids); real processes read their 1/N shard.
+    eval_host, eval_hosts = info.local_host_ids()
+    if not (FLAGS.data_dir and mnist_data.available(FLAGS.data_dir)):
+        held_out = SyntheticData("mnist", FLAGS.batch_size, seed=FLAGS.seed,
+                                 host_index=eval_host,
+                                 host_count=eval_hosts)
+        eval_batches = [held_out.batch(10_000_019)]
     else:
         test = mnist_data.MnistData(
             FLAGS.data_dir, FLAGS.batch_size, split="test", seed=FLAGS.seed,
-            host_index=info.process_id, host_count=info.num_processes)
+            host_index=eval_host, host_count=eval_hosts)
         # uniform across hosts: every process must drive the jitted eval
         # step the same number of times or the mesh deadlocks.
         eval_batches = itertools.islice(iter(test),

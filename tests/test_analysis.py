@@ -649,6 +649,65 @@ def test_srclint_fences_backend_imports_in_telemetry(tmp_path):
     assert not probs, probs
 
 
+def test_srclint_fences_backend_imports_in_fault(tmp_path):
+    """ISSUE 11 satellite: dtf_tpu/fault/ is fenced like telemetry/ and
+    tune/ — the run controller supervises a possibly-wedged backend from
+    a clean process and must never import what it has to outlive. Lazy
+    in-function imports pass; the shipping fault package must be clean."""
+    from dtf_tpu.analysis import srclint
+
+    fdir = tmp_path / "dtf_tpu" / "fault"
+    fdir.mkdir(parents=True)
+    bad = fdir / "bad.py"
+    bad.write_text("import jax\n\ndef f():\n    return jax.devices()\n")
+    probs = srclint.lint_file(str(bad))
+    assert sum("without a backend" in p for p in probs) == 1, probs
+    assert "dtf_tpu/fault/" in probs[0]
+
+    ok = fdir / "ok.py"
+    ok.write_text("def f():\n    import jax\n\n    return jax.devices()\n")
+    assert not srclint.lint_file(str(ok))
+
+    fault_dir = os.path.join(ROOT, "dtf_tpu", "fault")
+    probs = []
+    for f in sorted(os.listdir(fault_dir)):
+        if f.endswith(".py"):
+            probs += [p for p in srclint.lint_file(
+                os.path.join(fault_dir, f)) if "without a backend" in p]
+    assert not probs, probs
+
+
+def test_fault_package_imports_without_backend(tmp_path,
+                                               cpu_sim_subprocess_env):
+    """Dynamic twin: the controller imports and classifies in a child
+    whose jax/jaxlib/tensorflow imports are poisoned — the chief process
+    supervising a wedged backend must not be hangable by an import."""
+    import subprocess
+    import sys as _sys
+
+    poison = tmp_path / "poison"
+    for mod in ("jax", "tensorflow", "jaxlib"):
+        d = poison / mod
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text(
+            "raise ImportError('no backend on this machine')\n")
+    env = dict(cpu_sim_subprocess_env)
+    env["PYTHONPATH"] = f"{poison}{os.pathsep}{ROOT}"
+    code = (
+        "from dtf_tpu.fault import (ControllerConfig, ControllerPolicy,\n"
+        "                           HostObservation, FaultPlan)\n"
+        "p = ControllerPolicy()\n"
+        "d = p.classify([HostObservation(0, False, 137, None)],\n"
+        "               config=ControllerConfig(), since_launch_s=1)\n"
+        "assert d.kind == 'host_lost', d\n"
+        "assert FaultPlan.parse('kill@3').kind == 'kill'\n"
+        "print('NO_BACKEND_OK')\n")
+    proc = subprocess.run([_sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(tmp_path))
+    assert "NO_BACKEND_OK" in proc.stdout, (proc.stdout, proc.stderr)
+
+
 def test_telemetry_package_imports_without_jax_or_tf(
         tmp_path, cpu_sim_subprocess_env):
     """The dynamic twin of the srclint fence: the parser modules import

