@@ -102,6 +102,16 @@ class Checkpointer:
     def directory(self) -> str:
         return os.fspath(self._mgr.directory)
 
+    @property
+    def last_restored_step(self) -> int | None:
+        """The step the last guarded latest-step restore
+        (:meth:`restore`/:meth:`restore_params` with ``step=None``)
+        actually loaded — part of the fallback contract: it may be OLDER
+        than :meth:`latest_step` when the newest step was unreadable, and
+        callers reporting "what am I serving/resuming" must report this,
+        not latest. None before any guarded restore."""
+        return self._last_restored_step
+
     def save(self, step: int, state: PyTree, *, force: bool = False) -> bool:
         """Async sharded save. Returns True if a save was actually queued.
 
@@ -282,18 +292,7 @@ class Checkpointer:
             return self._mgr.restore(step,
                                      args=ocp.args.StandardRestore())
 
-    def restore_params(self, step: int | None = None) -> PyTree:
-        """Params-only restore — the serving startup entry.
-
-        New checkpoints carry a dedicated ``params`` item (see
-        :meth:`save`): only the weight bytes are read. Legacy single-item
-        checkpoints fall back to :meth:`restore_raw` (full-tree read,
-        opt_state included) with a warning, so old logdirs keep serving.
-        """
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(
-                f"no checkpoint found under {self.directory}")
+    def _restore_params_one(self, step: int) -> PyTree:
         if self._has_item(step, "params"):
             return self._mgr.restore(
                 step, args=ocp.args.Composite(
@@ -308,6 +307,58 @@ class Checkpointer:
                 f"checkpoint step {step} at {self.directory} has no "
                 "'params' subtree — not a TrainState checkpoint?")
         return raw["params"]
+
+    def restore_params(self, step: int | None = None) -> PyTree:
+        """Params-only restore — the serving startup entry.
+
+        New checkpoints carry a dedicated ``params`` item (see
+        :meth:`save`): only the weight bytes are read. Legacy single-item
+        checkpoints fall back to :meth:`restore_raw` (full-tree read,
+        opt_state included) with a warning, so old logdirs keep serving.
+
+        With ``step=None`` this rides the same guarded latest-step walk as
+        :meth:`restore` (ISSUE 12 parity): a corrupt/truncated newest
+        checkpoint WARNs and serves the next older readable step instead
+        of killing serving startup outright. Unambiguous WRONG-TARGET
+        errors (tree mismatch / not a TrainState checkpoint) still
+        re-raise immediately, and an explicitly requested step gets no
+        fallback — the caller asked for exactly that step.
+        """
+        if step is not None:
+            return self._restore_params_one(step)
+        steps = sorted(self._mgr.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        last_err: Exception | None = None
+        for i, s in enumerate(steps):
+            try:
+                params = self._restore_params_one(s)
+            except Exception as e:  # noqa: BLE001 — any unreadable-step
+                # class must fall back (restore()'s contract); only the
+                # unambiguous wrong-target phrasings re-raise
+                if _looks_structural(e) or "'params' subtree" in str(e):
+                    raise
+                last_err = e
+                older = steps[i + 1] if i + 1 < len(steps) else None
+                log.warning(
+                    "checkpoint step %d at %s is unreadable (%s: %.200s); "
+                    "falling back to %s", s, self.directory,
+                    type(e).__name__, e,
+                    f"step {older}" if older is not None
+                    else "nothing — no older step")
+                continue
+            if s != steps[0]:
+                log.warning(
+                    "serving params of step %d instead of the newest step "
+                    "%d (unreadable)", s, steps[0])
+            self._last_restored_step = s
+            return params
+        raise RuntimeError(
+            f"every checkpoint step under {self.directory} is unreadable "
+            f"(tried {steps}) — corrupt files, or a restore failure this "
+            f"guard didn't recognize; last error: "
+            f"{type(last_err).__name__}: {last_err}")
 
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:
         """(state, restored_step) — state unchanged if nothing on disk.

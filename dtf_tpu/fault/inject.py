@@ -46,9 +46,22 @@ ENV_VAR = "DTF_FAULT_INJECT"
 
 KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash")
 
+#: the SERVE-tier verbs (ISSUE 12) — same env var, same grammar, but they
+#: target the serving pump instead of the training loop, so the trainer
+#: hook (`FaultPlan.from_env`) and the serve installer
+#: (:func:`ServeFaultPlan.from_env` +
+#: :func:`dtf_tpu.serve.health.install_serve_fault`) each ignore the
+#: other family's kinds instead of erroring on them.
+SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request")
+
 
 class InjectedCrash(RuntimeError):
     """The ``crash@S`` payload — a host died, in exception form."""
+
+
+class InjectedPoison(RuntimeError):
+    """The ``poison_request@N`` payload — a request whose prefill raises
+    wherever it lands (serve chaos: the scheduler must isolate it)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,10 +98,58 @@ class FaultPlan:
     @classmethod
     def from_env(cls, env: Optional[Mapping] = None) -> Optional["FaultPlan"]:
         spec = (env if env is not None else os.environ).get(ENV_VAR, "")
-        return cls.parse(spec) if spec else None
+        if not spec or spec.partition("@")[0].strip() in SERVE_KINDS:
+            return None        # a serve verb rides past the trainer hook
+        return cls.parse(spec)
 
     def applies_to(self, host_index: int) -> bool:
         return self.host is None or self.host == host_index
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeFaultPlan:
+    """One seeded SERVE fault: ``<kind>@<tick>[:replica=<k>]``.
+
+    ``tick`` is counted in the target's own call domain — the k-th decode
+    call of the wedged/slowed replica's engine, or the N-th submit for
+    ``poison_request`` — so a plan is deterministic under open-loop
+    Poisson timing. ``replica=None`` targets every replica (poison plans
+    ignore the option: the poisoned request raises wherever it lands).
+    """
+
+    kind: str
+    tick: int
+    replica: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in SERVE_KINDS:
+            raise ValueError(
+                f"unknown serve fault kind {self.kind!r}; have {SERVE_KINDS}")
+        if self.tick < 0:
+            raise ValueError(f"fault tick must be >= 0, got {self.tick}")
+
+    @classmethod
+    def parse(cls, spec: str) -> "ServeFaultPlan":
+        body, _, tail = spec.strip().partition(":")
+        kind, at, tick = body.partition("@")
+        if not at:
+            raise ValueError(f"fault spec {spec!r} needs '<kind>@<tick>'")
+        replica = None
+        if tail:
+            key, _, val = tail.partition("=")
+            if key != "replica":
+                raise ValueError(
+                    f"unknown serve fault option {key!r} in {spec!r}")
+            replica = int(val)
+        return cls(kind=kind.strip(), tick=int(tick), replica=replica)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping] = None
+                 ) -> Optional["ServeFaultPlan"]:
+        spec = (env if env is not None else os.environ).get(ENV_VAR, "")
+        if not spec or spec.partition("@")[0].strip() not in SERVE_KINDS:
+            return None        # trainer verbs ride past the serve installer
+        return cls.parse(spec)
 
 
 class FaultHook:

@@ -22,17 +22,28 @@ from millions of users") actually asks for. Five layers:
   queue-depth tiebreak, ``router_wait`` spans and per-replica SLO rollups.
 - :mod:`dtf_tpu.serve.client` — in-process submit/poll API plus a seeded
   Poisson load generator for benching.
+- :mod:`dtf_tpu.serve.health` — the resilience tier (ISSUE 12): a
+  per-replica health state machine (healthy → degraded → quarantined →
+  probation) on the PR 11 stall-watchdog idiom, plus serve-side fault
+  injection (``DTF_FAULT_INJECT=wedge_replica@... | slow_decode |
+  poison_request``). Pairs with per-request deadlines, bounded-queue
+  load shedding and quarantine requeue in scheduler/router.
 
-docs/SERVING.md walks the architecture and the fixed-shape rules.
+docs/SERVING.md walks the architecture and the fixed-shape rules;
+docs/RESILIENCE.md "Serving" walks the failure semantics.
 """
 
 from dtf_tpu.serve.client import (Heartbeat, PoissonLoadGen, ServeClient,
                                   replay)
 from dtf_tpu.serve.engine import DecodeEngine, decode_step_view
+from dtf_tpu.serve.health import (HealthConfig, HealthTracker,
+                                  install_serve_fault)
 from dtf_tpu.serve.pages import PrefixIndex
 from dtf_tpu.serve.router import Router
-from dtf_tpu.serve.scheduler import Request, Scheduler
+from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
+                                     RequestFailed, Scheduler)
 
-__all__ = ["DecodeEngine", "Heartbeat", "PoissonLoadGen", "PrefixIndex",
-           "Request", "Router", "Scheduler", "ServeClient",
-           "decode_step_view", "replay"]
+__all__ = ["DecodeEngine", "FAILED_STATUSES", "Heartbeat", "HealthConfig",
+           "HealthTracker", "PoissonLoadGen", "PrefixIndex", "Request",
+           "RequestFailed", "Router", "Scheduler", "ServeClient",
+           "decode_step_view", "install_serve_fault", "replay"]

@@ -20,7 +20,8 @@ from typing import Iterator, Optional, Sequence
 
 import numpy as np
 
-from dtf_tpu.serve.scheduler import Request, Scheduler
+from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
+                                     RequestFailed, Scheduler)
 
 log = logging.getLogger("dtf_tpu")
 
@@ -57,9 +58,12 @@ def replay(scheduler: Scheduler, arrivals, *,
 _HEARTBEAT_KEYS = ("serve_completed", "serve_queue_depth",
                    "serve_occupancy", "serve_ttft_p50_s",
                    "serve_ttft_p99_s", "serve_ttft_slo_ok_frac",
+                   "serve_shed", "serve_timeouts",
                    "router_completed", "router_queue_depth",
                    "router_occupancy", "router_ttft_p50_s",
-                   "router_ttft_p99_s", "router_ttft_slo_ok_frac")
+                   "router_ttft_p99_s", "router_ttft_slo_ok_frac",
+                   "router_shed", "router_timeouts", "router_requeued",
+                   "router_quarantines")
 
 
 class Heartbeat:
@@ -73,12 +77,17 @@ class Heartbeat:
     p50/p99, and the SLO compliance fraction. When ``slo_floor > 0`` and
     the ok-fraction drops below it, a WARNING logs once per excursion
     (re-armed when compliance recovers — a sustained breach must not spam
-    one warning per tick). Host arithmetic only; stats() is already
-    readback-free.
+    one warning per tick); every excursion is COUNTED and the worst
+    ok-fraction retained, so :meth:`stats` can stamp both into the
+    launcher's final JSON line (a run that breached and recovered is not
+    allowed to look clean). With a ``flight`` recorder attached, each
+    emit also writes the atomic liveness heartbeat file with a ``serve``
+    summary — the PR 11 run-controller surface, serving edition. Host
+    arithmetic only; stats() is already readback-free.
     """
 
     def __init__(self, sched, *, every_ticks: int, slo_floor: float = 0.0,
-                 emit=None, clock=time.monotonic):
+                 emit=None, clock=time.monotonic, flight=None):
         if every_ticks < 1:
             raise ValueError(f"every_ticks={every_ticks} must be >= 1")
         self.sched = sched
@@ -86,9 +95,12 @@ class Heartbeat:
         self.slo_floor = slo_floor
         self.emit = emit or (lambda line: print(line, file=sys.stderr))
         self.clock = clock
+        self.flight = flight
         self._t0 = clock()
         self._ticks = 0
         self.emitted = 0
+        self.excursions = 0
+        self.worst_ok_frac: float | None = None
         self._below_floor = False
 
     def snapshot(self) -> dict:
@@ -119,17 +131,40 @@ class Heartbeat:
         self.emitted += 1
         self.emit(json.dumps(snap))
         ok = self._slo_ok_frac(snap)
+        if ok is not None:
+            self.worst_ok_frac = (ok if self.worst_ok_frac is None
+                                  else min(self.worst_ok_frac, ok))
         if self.slo_floor > 0.0 and ok is not None:
             if ok < self.slo_floor and not self._below_floor:
                 self._below_floor = True
+                self.excursions += 1
                 log.warning(
                     "TTFT SLO compliance %.3f below the %.3f floor "
-                    "(p99 %.4fs)", ok, self.slo_floor,
+                    "(p99 %.4fs; excursion %d)", ok, self.slo_floor,
                     snap.get("router_ttft_p99_s",
-                             snap.get("serve_ttft_p99_s", 0.0)))
+                             snap.get("serve_ttft_p99_s", 0.0)),
+                    self.excursions)
             elif ok >= self.slo_floor:
                 self._below_floor = False
+        if self.flight is not None:
+            # the run-controller liveness surface: the heartbeat file a
+            # chief-side watcher polls, with the serve panel riding along
+            self.flight.write_heartbeat(extra={"serve": {
+                k: snap[k] for k in
+                ("serve_completed", "serve_queue_depth", "router_completed",
+                 "router_queue_depth", "router_quarantines")
+                if k in snap}})
         return snap
+
+    def stats(self) -> dict:
+        """SLO-excursion aggregates for the launcher's final JSON line:
+        how often compliance dipped below the floor and how bad the worst
+        dip was (a breach-and-recover run must not look clean)."""
+        out = {"heartbeats": float(self.emitted),
+               "slo_excursions": float(self.excursions)}
+        if self.worst_ok_frac is not None:
+            out["worst_ttft_slo_ok_frac"] = round(self.worst_ok_frac, 6)
+        return out
 
 
 class ServeClient:
@@ -155,11 +190,16 @@ class ServeClient:
         self.scheduler.tick()
 
     def result(self, rid: int, max_ticks: int = 100000) -> list[int]:
-        """Generated tokens of ``rid`` (pumps the scheduler until done)."""
+        """Generated tokens of ``rid`` (pumps the scheduler until done).
+        A shed/timed-out/errored request raises :class:`RequestFailed`
+        IMMEDIATELY — terminal statuses must not spin ``max_ticks`` to
+        exhaustion on a request that will never finish."""
         for _ in range(max_ticks):
             st = self.poll(rid)
             if st["status"] == "done":
                 return st["tokens"]
+            if st["status"] in FAILED_STATUSES:
+                raise RequestFailed(rid, st)
             self.scheduler.tick()
         raise RuntimeError(f"request {rid} not done after {max_ticks} ticks")
 

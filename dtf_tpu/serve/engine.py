@@ -367,7 +367,8 @@ class DecodeEngine:
         #: the bench/telemetry surface for "how much prefill work ran".
         self.counters = {"prefill_chunks": 0, "decode_steps": 0,
                          "pages_loaded": 0, "pages_saved": 0,
-                         "prefix_hit_tokens": 0, "prefix_miss_tokens": 0}
+                         "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
+                         "probe_decodes": 0}
         #: when True, each compiled-program dispatch is wrapped in a
         #: jax.profiler.TraceAnnotation carrying the request trace id(s) the
         #: scheduler threaded down — a ProfilerHook window over a serving
@@ -582,6 +583,22 @@ class DecodeEngine:
         self.counters["decode_steps"] += 1
         return np.asarray(out["token"]), np.asarray(out["done"])
 
+    def probe(self) -> None:
+        """One decode dispatch with the outputs discarded — the Router's
+        PROBATION health probe: a re-admitted replica proves the engine
+        answers at normal latency before live traffic gambles on it.
+        Deliberately routes through :meth:`decode` (NOT the raw compiled
+        executable): anything wrapping the instance's ``decode`` — the
+        serve fault injectors, a future engine proxy — must be observed
+        by the probe, or a still-wedged replica would probe clean and be
+        re-admitted into an oscillation. Same compiled ``decode_all``
+        program (no retrace — ``trace_counts`` stays pinned); stale slots
+        advance like any other masked step, which is safe by the PR 4
+        reset contract: an admitted request fully reinitializes its slot,
+        so probes can never perturb request tokens."""
+        self.decode()
+        self.counters["probe_decodes"] += 1
+
     # ----------------------------------------------------- prefix page API
 
     def prefix_match(self, prompt: Sequence[int]):
@@ -676,7 +693,11 @@ class DecodeEngine:
             return {}
         return {**self._prefix.stats,
                 "pages": self.n_pages - self._prefix.n_free,
-                "pages_free": self._prefix.n_free}
+                "pages_free": self._prefix.n_free,
+                # live pins should drain to 0 once every admitted request
+                # released its handle — a leak here is a requeue/evict
+                # path dropping the pages.py refcount contract
+                "pinned": self._prefix.pinned()}
 
     def cache_bytes(self) -> int:
         """Resident KV footprint: slot cache + page pool, all layers."""

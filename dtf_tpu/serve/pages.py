@@ -33,6 +33,16 @@ Design rules (the fixed-shape discipline of docs/SERVING.md, extended):
   pollution plus host overhead that can exceed the prefill work saved;
   the second-sighting rule caches exactly the prefixes traffic repeats.
 
+The page pool doubles as the **requeue KV transport** of the serve
+resilience tier (docs/RESILIENCE.md "Serving"): when the Router drains a
+quarantined replica, each re-admitted request goes through ordinary
+admission on its survivor — a cached stem re-prefills as ONE page gather
+and only the uncached tail replays through the transformer. The drain
+itself releases the dead replica's in-flight pins
+(``Scheduler.evict_for_requeue``), so its pool pages become evictable
+instead of leaking; ``DecodeEngine.prefix_stats()["pinned"]`` is the
+leak tripwire.
+
 The device half (pool state + the two AOT page programs) lives in
 ``engine.py``; :func:`pool_abstract` here builds the pool's abstract
 struct from the engine's cache struct so the two cannot desynchronize.

@@ -23,22 +23,40 @@ Observability is the PR 5 span surface, serving edition:
 The router is drop-in for the scheduler in the pump loop: it exposes the
 same ``submit/tick/pending`` surface, so :func:`dtf_tpu.serve.client.replay`
 drives a fleet exactly like a single scheduler (the bench A/B rides this).
+
+Resilience (ISSUE 12): with more than one replica the router runs a
+per-replica health state machine (:mod:`dtf_tpu.serve.health`) by
+default — every replica tick is wall-timed on the router's clock, a
+wedged or repeatedly-slow replica is **quarantined** (``_pick`` skips it,
+its ticks stop, its in-flight requests are requeued onto survivors in
+submit order), and after a probation delay it is re-admitted on trial
+(idle probation replicas are exercised via ``DecodeEngine.probe``).
+Requeue is a full deterministic replay — the survivor re-prefills the
+prompt (cached stems land in one page gather where the survivor's prefix
+pool has them) and regenerates the token stream, bitwise identical to a
+fault-free run of the same request. When NO replica is routable the
+router sheds at the front door with a ``retry_after_s`` derived from the
+earliest probation ETA. docs/RESILIENCE.md "Serving" walks the states
+and the chaos matrix that pins the behavior.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Sequence
+from typing import Optional, Sequence
 
 from dtf_tpu.metrics import quantile as _quantile
+from dtf_tpu.serve import health as health_lib
 from dtf_tpu.serve.engine import DecodeEngine
-from dtf_tpu.serve.scheduler import Request, Scheduler
+from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
+                                     RequestFailed, Scheduler)
 
 #: per-replica stat keys surfaced as ``replica{i}_<key>`` (the SLO panel);
 #: everything else stays per-scheduler to keep the JSON line bounded.
 _REPLICA_KEYS = ("serve_completed", "serve_occupancy_mean",
                  "serve_ttft_p50_s", "serve_ttft_p99_s",
-                 "serve_queue_peak", "serve_ttft_slo_ok_frac")
+                 "serve_queue_peak", "serve_ttft_slo_ok_frac",
+                 "serve_shed", "serve_timeouts", "serve_requeued_in")
 
 
 class Router:
@@ -51,15 +69,31 @@ class Router:
 
     def __init__(self, engines: Sequence[DecodeEngine], writer=None, *,
                  telemetry=None, ttft_slo_s: float = 0.0,
-                 clock=time.monotonic, **scheduler_kw):
+                 clock=time.monotonic, health=None, **scheduler_kw):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
         self.telemetry = telemetry
+        self.clock = clock
         self.schedulers = [
             Scheduler(e, writer, telemetry=telemetry,
                       ttft_slo_s=ttft_slo_s, clock=clock,
                       postmortem_name=None, **scheduler_kw)
             for e in engines]
+        # replica health: ON by default for a real fleet (>1 replica —
+        # quarantine needs survivors to requeue onto); pass a
+        # HealthConfig to tune thresholds or force it for a single
+        # replica, False to disable outright.
+        if health is False:
+            self.health: Optional[health_lib.HealthTracker] = None
+        elif isinstance(health, health_lib.HealthTracker):
+            self.health = health
+        elif isinstance(health, health_lib.HealthConfig):
+            self.health = health_lib.HealthTracker(
+                len(engines), health, clock=clock)
+        elif health is None and len(engines) == 1:
+            self.health = None
+        else:    # None with a fleet, or True
+            self.health = health_lib.HealthTracker(len(engines), clock=clock)
         if telemetry is not None:
             # ONE aggregate postmortem provider for the fleet (each
             # replica's provider would collide on the name): in-flight
@@ -68,6 +102,12 @@ class Router:
                 "serve_router", self.postmortem_state)
         self.ttft_slo_s = ttft_slo_s
         self._where: dict[int, tuple[int, int]] = {}
+        #: front-door sheds (no routable replica): terminal records the
+        #: schedulers never saw, bounded like their completed retention.
+        self._router_shed: dict[int, dict] = {}
+        self._shed_cap = int(scheduler_kw.get("completed_cap", 100_000))
+        self._shed_router = 0
+        self._requeued = 0
         self._next_id = 0
 
     @classmethod
@@ -91,13 +131,34 @@ class Router:
 
     # ------------------------------------------------------------ admission
 
-    def _pick(self) -> int:
-        """Least occupancy; queue depth breaks the tie (every replica
-        saturated → the shortest line), replica index breaks that
-        (deterministic tests)."""
-        return min(range(len(self.schedulers)),
-                   key=lambda i: (self.schedulers[i].occupancy,
+    def _routable(self, i: int) -> bool:
+        return self.health is None or self.health.routable(i)
+
+    def _pick(self) -> Optional[int]:
+        """Least occupancy over ROUTABLE replicas (health rank first:
+        healthy before degraded before probation); queue depth breaks the
+        tie (every replica saturated → the shortest line), replica index
+        breaks that (deterministic tests). None when the whole fleet is
+        quarantined — the caller sheds at the front door."""
+        cands = [i for i in range(len(self.schedulers)) if self._routable(i)]
+        if not cands:
+            return None
+        rank = (self.health.rank if self.health is not None
+                else (lambda i: 0))
+        return min(cands,
+                   key=lambda i: (rank(i), self.schedulers[i].occupancy,
                                   self.schedulers[i].queue_depth, i))
+
+    def _shed_at_door(self, rid: int) -> None:
+        eta = (self.health.quarantined_eta_s()
+               if self.health is not None else None)
+        self._router_shed[rid] = {
+            "status": "shed", "tokens": [],
+            "retry_after_s": round(eta if eta is not None else 1.0, 3)}
+        self._where.pop(rid, None)
+        self._shed_router += 1
+        while len(self._router_shed) > self._shed_cap:
+            self._router_shed.pop(next(iter(self._router_shed)))
 
     def submit(self, req: Request) -> int:
         i = self._pick()
@@ -107,6 +168,12 @@ class Router:
         # Increment only after the replica ACCEPTED — a rejected submit
         # (over-long prompt) must not consume a fleet id.
         rid = self._next_id
+        if i is None:
+            # nothing routable: shed at the front door with the earliest
+            # probation ETA as the honest retry hint
+            self._next_id += 1
+            self._shed_at_door(rid)
+            return rid
         local = self.schedulers[i].submit(req, trace_id=rid)
         self._next_id += 1
         self._where[rid] = (i, local)
@@ -117,10 +184,68 @@ class Router:
         return self._where[rid][0]
 
     def postmortem_state(self) -> dict:
-        """Fleet postmortem context: per-replica in-flight request ids and
-        slot ages (host facts only — the flight-recorder dump contract)."""
-        return {f"replica{i}": s.postmortem_state()
-                for i, s in enumerate(self.schedulers)}
+        """Fleet postmortem context: per-replica in-flight request ids,
+        slot ages and health verdicts (host facts only — the
+        flight-recorder dump contract)."""
+        out = {f"replica{i}": s.postmortem_state()
+               for i, s in enumerate(self.schedulers)}
+        out["router"] = {"shed_at_door": self._shed_router,
+                         "requeued": self._requeued}
+        if self.health is not None:
+            out["router"]["health"] = self.health.states()
+            out["router"]["health_counters"] = dict(self.health.counters)
+            out["router"]["health_transitions"] = \
+                list(self.health.transitions)[-10:]
+        return out
+
+    # ------------------------------------------------------ quarantine drain
+
+    def quarantine(self, i: int, cause: str = "forced") -> None:
+        """Quarantine replica ``i`` now and requeue its in-flight
+        requests onto survivors (operator/test API; the health watchdog
+        reaches the same path through :meth:`tick`'s verdicts)."""
+        if self.health is None:
+            raise RuntimeError(
+                "Router health is disabled (single replica without an "
+                "explicit HealthConfig) — nothing to quarantine with")
+        self.health.quarantine(i, cause)
+        self._requeue_from(i)
+
+    def _requeue_from(self, i: int) -> None:
+        """Drain quarantined replica ``i``: every in-flight request is
+        re-submitted to a survivor in submit order with its ORIGINAL
+        fleet rid, trace id and submit time — the survivor re-prefills
+        (cached stems in one page gather where its prefix pool has them)
+        and regenerates the deterministic token stream, so completed
+        tokens are bitwise identical to a fault-free run. With no
+        routable survivor the request sheds at the front door."""
+        for rec in self.schedulers[i].evict_for_requeue():
+            rid = rec.trace_id     # the fleet-global id (we threaded it)
+            j = self._pick()       # never i: quarantined is not routable
+            if j is None:
+                self._shed_at_door(rid)
+                continue
+            local = self.schedulers[j].submit(
+                rec.req, trace_id=rid, submit_t=rec.submit_t, requeued=True)
+            self._where[rid] = (j, local)
+            self._requeued += 1
+
+    def _probe(self, i: int) -> None:
+        """Exercise an idle probation replica with one timed decode probe
+        so re-admission does not have to wait for (and gamble) live
+        traffic. Engines without a ``probe`` (fakes) skip — their
+        probation resolves through routed requests instead."""
+        probe = getattr(self.schedulers[i].engine, "probe", None)
+        if probe is None:
+            return
+        t0 = self.clock()
+        try:
+            probe()
+        except Exception as e:  # noqa: BLE001 — a probe failure is the
+            # quarantine signal working; nothing to requeue (idle replica)
+            self.health.note_fault(i, e)
+            return
+        self.health.note_tick(i, self.clock() - t0)
 
     # ----------------------------------------------------------- pump surface
 
@@ -129,11 +254,36 @@ class Router:
         return sum(s.pending for s in self.schedulers)
 
     def tick(self) -> None:
-        """One scheduling round on every replica with work — replicas are
-        independent KV state, so their ticks never contend for slots."""
-        for s in self.schedulers:
-            if s.pending:
+        """One scheduling round on every ROUTABLE replica with work —
+        replicas are independent KV state, so their ticks never contend
+        for slots. With health on, each tick is wall-timed and fed to the
+        watchdog; a quarantine verdict (slow/wedged/faulted) immediately
+        drains that replica onto survivors, so the pump loop never calls
+        into a wedged engine again."""
+        h = self.health
+        if h is None:
+            for s in self.schedulers:
+                if s.pending:
+                    s.tick()
+            return
+        for i, s in enumerate(self.schedulers):
+            if not h.routable(i):
+                continue
+            if not s.pending:
+                if h.state(i) == health_lib.PROBATION:
+                    self._probe(i)
+                continue
+            t0 = self.clock()
+            try:
                 s.tick()
+            except Exception as e:  # noqa: BLE001 — a decode-path engine
+                # failure has no single owning request: quarantine the
+                # replica and replay its in-flight work on survivors
+                h.note_fault(i, e)
+                self._requeue_from(i)
+                continue
+            if h.note_tick(i, self.clock() - t0) == health_lib.QUARANTINED:
+                self._requeue_from(i)
 
     def run_until_idle(self, max_ticks: int = 100000, *,
                        on_tick=None) -> None:
@@ -146,6 +296,9 @@ class Router:
         raise RuntimeError(f"requests still pending after {max_ticks} ticks")
 
     def poll(self, rid: int) -> dict:
+        shed = self._router_shed.get(rid)
+        if shed is not None:
+            return dict(shed)
         i, local = self._where[rid]
         return self.schedulers[i].poll(local)
 
@@ -154,10 +307,16 @@ class Router:
             st = self.poll(rid)
             if st["status"] == "done":
                 return st["tokens"]
+            if st["status"] in FAILED_STATUSES:
+                # shed/timeout/error are TERMINAL: raise now instead of
+                # pumping max_ticks on a request that will never finish
+                raise RequestFailed(rid, st)
             self.tick()
         raise RuntimeError(f"request {rid} not done after {max_ticks} ticks")
 
     def release(self, rid: int) -> None:
+        if self._router_shed.pop(rid, None) is not None:
+            return
         i, local = self._where.pop(rid)
         self.schedulers[i].release(local)
 
@@ -188,6 +347,20 @@ class Router:
         }
         if brief:
             return out
+        out["router_shed"] = float(self._shed_router
+                                   + sum(s._shed for s in self.schedulers))
+        out["router_timeouts"] = float(sum(s._timeouts
+                                           for s in self.schedulers))
+        out["router_request_errors"] = float(
+            sum(s._request_errors for s in self.schedulers))
+        out["router_requeued"] = float(self._requeued)
+        if self.health is not None:
+            hc = self.health.counters
+            out["router_quarantines"] = float(hc["quarantines"])
+            out["router_probation_readmits"] = float(hc["readmits"])
+            out["router_replica_faults"] = float(hc["faults"])
+            for i in range(n):
+                out[f"replica{i}_health"] = self.health.state(i)
         ttfts = [t for s in self.schedulers for t in s._ttfts]
         out["router_ttft_p50_s"] = _quantile(ttfts, 0.5)
         out["router_ttft_p99_s"] = _quantile(ttfts, 0.99)

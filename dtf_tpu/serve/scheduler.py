@@ -29,21 +29,71 @@ unit replacing ``n_cached/prefill_chunk`` chunks of transformer work),
 the live chunks continue at ``start = n_cached``, new full pages scatter
 back in one dispatch after the last chunk, and the pin is released on
 slot evict — the refcount contract of :mod:`dtf_tpu.serve.pages`.
+
+Resilience (ISSUE 12, docs/RESILIENCE.md "Serving"): requests can end in
+a terminal status other than ``done`` —
+
+- ``shed`` — bounded-queue admission control (``max_queue``): an
+  over-full queue rejects at submit with a ``retry_after_s`` hint
+  instead of growing host memory and tail latency without bound;
+- ``timeout`` — per-request deadlines (``Request.ttft_deadline_s`` /
+  ``deadline_s``, measured from submit on the scheduler clock) evict at
+  the next tick, whether the request is still queued, mid-prefill, or
+  decoding;
+- ``error`` — an engine exception during ADMISSION is attributed to the
+  admitting request and isolates to it (the ``poison_request`` chaos
+  verb); decode-path exceptions have no single owner and propagate to
+  the Router's health machinery, which quarantines the replica and
+  requeues its in-flight requests (:meth:`Scheduler.evict_for_requeue`,
+  status ``requeued`` on the vacated replica).
+
+``poll`` reports the terminal status (+hint/cause fields);
+:class:`RequestFailed` is what ``result()`` raises immediately instead of
+spinning ``max_ticks`` on a request that will never finish.
 """
 
 from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
 import time
 from typing import Optional, Sequence
 
 from dtf_tpu.metrics import quantile as _quantile
 
+log = logging.getLogger("dtf_tpu")
+
+#: terminal statuses that are NOT success — ``result()`` raises
+#: :class:`RequestFailed` on sight instead of pumping to tick exhaustion.
+FAILED_STATUSES = ("shed", "timeout", "error")
+
+
+class RequestFailed(RuntimeError):
+    """A request ended in a terminal non-success status (``shed`` /
+    ``timeout`` / ``error``). Carries the ``poll()`` payload so callers
+    can honor ``retry_after_s`` without a second lookup."""
+
+    def __init__(self, rid: int, info: dict):
+        self.rid = rid
+        self.status = info.get("status", "?")
+        self.info = dict(info)
+        hint = ""
+        if "retry_after_s" in info:
+            hint = f" (retry after {info['retry_after_s']}s)"
+        elif info.get("timeout_kind"):
+            hint = f" ({info['timeout_kind']} deadline)"
+        elif info.get("error"):
+            hint = f" ({info['error']})"
+        super().__init__(f"request {rid} terminally {self.status}{hint}")
+
 
 @dataclasses.dataclass(frozen=True)
 class Request:
-    """One decode request. Sampling fields mirror ``gpt.generate``."""
+    """One decode request. Sampling fields mirror ``gpt.generate``;
+    the deadline fields are client promises measured from submit on the
+    scheduler's clock (0 = none): ``ttft_deadline_s`` bounds the wait for
+    the FIRST token, ``deadline_s`` the whole request."""
 
     prompt: Sequence[int]
     max_new: int = 32
@@ -53,18 +103,24 @@ class Request:
     eos_id: Optional[int] = None
     pad_id: int = 0
     seed: int = 0
+    ttft_deadline_s: float = 0.0
+    deadline_s: float = 0.0
 
 
 @dataclasses.dataclass
 class _Rec:
     rid: int
     req: Request
-    status: str = "queued"            # queued | prefill | running | done
+    #: queued | prefill | running | done | shed | timeout | error | requeued
+    status: str = "queued"
     slot: int = -1
     chunks_done: int = 0
     tokens: list = dataclasses.field(default_factory=list)
     submit_t: float = 0.0
-    first_token_t: float = 0.0
+    #: None until the first token lands — NOT 0.0: an injectable test
+    #: clock legitimately stamps first tokens at t == 0.0, and a falsy
+    #: check would re-arm the TTFT deadline on an actively-decoding row
+    first_token_t: Optional[float] = None
     finish_t: float = 0.0
     #: pinned prefix-page chain (engine.prefix_match) — pages loaded so
     #: far, released on slot evict (the refcount contract).
@@ -76,6 +132,10 @@ class _Rec:
     trace_id: int = -1
     #: submit moment on the TraceCollector's clock (chrome ts domain)
     submit_us: float = 0.0
+    retry_after_s: float = 0.0        # shed hint (poll surfaces it)
+    timeout_kind: str = ""            # "ttft" | "total" on timeout
+    error: str = ""                   # admission-failure cause on error
+    requeued: bool = False            # re-admitted off a quarantined replica
 
 
 class Scheduler:
@@ -89,7 +149,8 @@ class Scheduler:
     def __init__(self, engine, writer=None, *, log_every: int = 0,
                  prefill_chunks_per_tick: int = 4, clock=time.monotonic,
                  completed_cap: int = 100_000, telemetry=None,
-                 ttft_slo_s: float = 0.0,
+                 ttft_slo_s: float = 0.0, max_queue: int = 0,
+                 shed_retry_after_s: float = 0.25,
                  postmortem_name: Optional[str] = "serve_scheduler"):
         self.engine = engine
         self.writer = writer
@@ -116,6 +177,15 @@ class Scheduler:
                 "be >= 0 (0 = admit greedily)")
         self.prefill_chunks_per_tick = prefill_chunks_per_tick
         self.clock = clock
+        if max_queue < 0:
+            raise ValueError(f"max_queue={max_queue} must be >= 0 "
+                             "(0 = unbounded)")
+        #: bounded-queue admission control: with ``max_queue > 0`` a
+        #: submit against a full queue is SHED (terminal status + a
+        #: retry_after_s hint) instead of queueing forever — overload
+        #: sheds load, it does not grow tail latency without bound.
+        self.max_queue = max_queue
+        self.shed_retry_after_s = shed_retry_after_s
         #: completed records (and latency samples) retained for poll();
         #: beyond the cap the OLDEST finished request is forgotten — a
         #: long-running server must not grow host memory per request.
@@ -137,13 +207,27 @@ class Scheduler:
         self._completed = 0
         self._occupancy_sum = 0.0
         self._queue_peak = 0
+        # resilience counters (host ints — the stats()/postmortem panel)
+        self._shed = 0
+        self._timeouts = 0
+        self._timeouts_ttft = 0
+        self._request_errors = 0
+        self._requeued_out = 0
+        self._requeued_in = 0
+        # deadline sweeps only run once a deadlined request has been seen
+        self._any_deadlines = False
 
     # ----------------------------------------------------------- submit/poll
 
-    def submit(self, req: Request, *, trace_id: Optional[int] = None) -> int:
+    def submit(self, req: Request, *, trace_id: Optional[int] = None,
+               submit_t: Optional[float] = None,
+               requeued: bool = False) -> int:
         """Accept a request; returns the local rid. ``trace_id`` threads an
         end-to-end id through every span this request touches (the Router
-        passes its fleet-global rid; standalone, the local rid is the id)."""
+        passes its fleet-global rid; standalone, the local rid is the id).
+        ``submit_t``/``requeued`` are the Router's requeue path: a request
+        re-admitted off a quarantined replica keeps its ORIGINAL submit
+        moment, so its TTFT and deadlines honestly include the lost time."""
         if not 1 <= len(req.prompt) <= self.engine.max_len - 1:
             raise ValueError(
                 f"prompt length {len(req.prompt)} must be in "
@@ -152,19 +236,41 @@ class Scheduler:
             raise ValueError(f"max_new={req.max_new} must be >= 1")
         rid = self._next_id
         self._next_id += 1
-        rec = _Rec(rid, req, submit_t=self.clock(),
+        rec = _Rec(rid, req, requeued=requeued,
+                   submit_t=self.clock() if submit_t is None else submit_t,
                    trace_id=rid if trace_id is None else trace_id)
         tracer = self._tracer()
         if tracer is not None:
             rec.submit_us = tracer.now_us()
         self._recs[rid] = rec
+        if requeued:
+            self._requeued_in += 1
+        if req.ttft_deadline_s > 0 or req.deadline_s > 0:
+            self._any_deadlines = True
+        if self.max_queue and len(self._queue) >= self.max_queue:
+            # admission control: shed NOW with an honest hint instead of
+            # joining a line that already guarantees a deadline miss
+            rec.status = "shed"
+            rec.retry_after_s = round(
+                self.shed_retry_after_s
+                * (1 + len(self._queue) / self.max_queue), 6)
+            self._shed += 1
+            self._remember_done(rec)
+            return rid
         self._queue.append(rec)
         self._queue_peak = max(self._queue_peak, len(self._queue))
         return rid
 
     def poll(self, rid: int) -> dict:
         rec = self._recs[rid]
-        return {"status": rec.status, "tokens": list(rec.tokens)}
+        out = {"status": rec.status, "tokens": list(rec.tokens)}
+        if rec.status == "shed":
+            out["retry_after_s"] = rec.retry_after_s
+        elif rec.status == "timeout":
+            out["timeout_kind"] = rec.timeout_kind
+        elif rec.status == "error":
+            out["error"] = rec.error
+        return out
 
     @property
     def pending(self) -> int:
@@ -175,8 +281,11 @@ class Scheduler:
     # ------------------------------------------------------------------ tick
 
     def tick(self) -> None:
-        """One scheduling round: bounded prefill, then one decode step."""
+        """One scheduling round: deadline sweep, bounded prefill, then one
+        decode step."""
         self._tick += 1
+        if self._any_deadlines:
+            self._sweep_deadlines()
         budget = self.prefill_chunks_per_tick or 10 ** 9
         while budget > 0:
             if self._admitting is None:
@@ -204,37 +313,52 @@ class Scheduler:
                     rec.handle = pm(rec.req.prompt)
             rec = self._admitting
             r = rec.req
-            if rec.handle is not None and not rec.pages_loaded:
-                # the whole pinned chain lands in ONE compiled gather —
-                # n_tokens/chunk prefill chunks of work for one budget
-                # unit (it still spends budget so admission cannot starve
-                # decode, and the load deactivates the slot first)
-                self._timed("serve_page_load", self.engine.load_prefix,
-                            rec.slot, rec.handle, tid=rec.trace_id)
-                rec.pages_loaded = len(rec.handle.entries)
+            try:
+                if rec.handle is not None and not rec.pages_loaded:
+                    # the whole pinned chain lands in ONE compiled gather —
+                    # n_tokens/chunk prefill chunks of work for one budget
+                    # unit (it still spends budget so admission cannot
+                    # starve decode, and the load deactivates the slot
+                    # first)
+                    self._timed("serve_page_load", self.engine.load_prefix,
+                                rec.slot, rec.handle, tid=rec.trace_id)
+                    rec.pages_loaded = len(rec.handle.entries)
+                    budget -= 1
+                    continue
+                start = rec.handle.n_tokens if rec.handle is not None else 0
+                # the trace id reaches the ENGINE (XPlane annotation) only
+                # when it opted in — simple engines need not know about ids
+                ekw = ({"trace_id": rec.trace_id}
+                       if getattr(self.engine, "annotate_traces", False)
+                       else {})
+                out = self._timed(
+                    "serve_prefill_chunk", self.engine.prefill_chunk_into,
+                    rec.slot, r.prompt, rec.chunks_done, start=start,
+                    temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
+                    eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed,
+                    tid=rec.trace_id,
+                    targs={"slot": rec.slot, "chunk": rec.chunks_done},
+                    **ekw)
+            except Exception as e:  # noqa: BLE001 — an ADMISSION failure
+                # has exactly one owner: fail that request terminally and
+                # keep the replica serving (poison_request isolation).
+                # Decode-path exceptions below have no single owner and
+                # propagate to the Router's health machinery instead.
+                self._fail(rec, e)
                 budget -= 1
                 continue
-            start = rec.handle.n_tokens if rec.handle is not None else 0
-            # the trace id reaches the ENGINE (XPlane annotation) only
-            # when it opted in — simple engines need not know about ids
-            ekw = ({"trace_id": rec.trace_id}
-                   if getattr(self.engine, "annotate_traces", False)
-                   else {})
-            out = self._timed(
-                "serve_prefill_chunk", self.engine.prefill_chunk_into,
-                rec.slot, r.prompt, rec.chunks_done, start=start,
-                temperature=r.temperature, top_k=r.top_k, top_p=r.top_p,
-                eos_id=r.eos_id, pad_id=r.pad_id, seed=r.seed,
-                tid=rec.trace_id,
-                targs={"slot": rec.slot, "chunk": rec.chunks_done}, **ekw)
             rec.chunks_done += 1
             budget -= 1
             if out is not None:                      # last chunk: tok0
                 tok, done = out
                 save = getattr(self.engine, "save_prefix_pages", None)
                 if save is not None:
-                    self._timed("serve_page_save", save, rec.slot, r.prompt,
-                                tid=rec.trace_id)
+                    try:
+                        self._timed("serve_page_save", save, rec.slot,
+                                    r.prompt, tid=rec.trace_id)
+                    except Exception as e:  # noqa: BLE001 — same owner
+                        self._fail(rec, e)
+                        continue
                 rec.first_token_t = self.clock()
                 rec.tokens.append(tok)
                 self._admitting = None
@@ -333,33 +457,135 @@ class Scheduler:
         return len(self._queue) + (self._admitting is not None)
 
     def _finish(self, rec: _Rec) -> None:
-        rec.status = "done"
         rec.finish_t = rec.finish_t or self.clock()
-        tracer = self._tracer()
-        if tracer is not None:
-            # the request's whole lifecycle as ONE slice on its own track
-            # — renders submit → done in Perfetto with the engine-call
-            # slices (tagged with the same trace id) nested visually
-            tracer.complete(
-                "request", cat="request", tid=rec.trace_id,
-                t0_us=rec.submit_us, t1_us=tracer.now_us(),
-                args={"rid": rec.rid, "prompt_len": len(rec.req.prompt),
-                      "tokens": len(rec.tokens),
-                      "ttft_s": round(rec.first_token_t - rec.submit_t, 6)})
-        if rec.handle is not None:       # refcount release on slot evict
-            self.engine.release_prefix(rec.handle)
-            rec.handle = None
         if len(rec.tokens) > 1:
             self._tok_lats.append((rec.finish_t - rec.first_token_t)
                                   / (len(rec.tokens) - 1))
         self._completed += 1
-        self._running.pop(rec.slot, None)
-        self._free.append(rec.slot)
-        self._free.sort()
-        rec.slot = -1
+        self._retire(rec, "done")
+
+    def _retire(self, rec: _Rec, status: str,
+                now: Optional[float] = None) -> None:
+        """Shared terminal bookkeeping for done/shed/timeout/error: stamp
+        the status, emit the lifecycle trace slice, release the prefix
+        pin, free the slot (if the request held one) and enter the
+        bounded retention window."""
+        rec.status = status
+        rec.finish_t = rec.finish_t or (self.clock() if now is None else now)
+        tracer = self._tracer()
+        if tracer is not None:
+            # the request's whole lifecycle as ONE slice on its own track
+            # — renders submit → terminal in Perfetto with the engine-call
+            # slices (tagged with the same trace id) nested visually
+            args = {"rid": rec.rid, "status": status,
+                    "prompt_len": len(rec.req.prompt),
+                    "tokens": len(rec.tokens)}
+            if rec.first_token_t is not None:
+                args["ttft_s"] = round(rec.first_token_t - rec.submit_t, 6)
+            tracer.complete("request", cat="request", tid=rec.trace_id,
+                            t0_us=rec.submit_us, t1_us=tracer.now_us(),
+                            args=args)
+        if rec.handle is not None:       # refcount release on slot evict
+            self.engine.release_prefix(rec.handle)
+            rec.handle = None
+        if rec.slot >= 0:
+            self._running.pop(rec.slot, None)
+            self._free.append(rec.slot)
+            self._free.sort()
+            rec.slot = -1
+        self._remember_done(rec)
+
+    def _remember_done(self, rec: _Rec) -> None:
         self._done_order.append(rec.rid)
         while len(self._done_order) > self.completed_cap:
             self._recs.pop(self._done_order.popleft(), None)
+
+    def _fail(self, rec: _Rec, e: BaseException) -> None:
+        """An admission-path engine failure owned by ``rec``: fail it
+        terminally (status ``error``) and keep serving — the chaos
+        contract that one poisoned request cannot take the replica with
+        it. The device slot needs no cleanup: a half-prefilled slot is
+        stale state the next admission fully resets (PR 4 contract)."""
+        self._request_errors += 1
+        rec.error = repr(e)[:200]
+        log.warning("request %d failed in admission: %s",
+                    rec.rid, rec.error)
+        if self._admitting is rec:
+            self._admitting = None
+        self._retire(rec, "error")
+
+    def _timeout(self, rec: _Rec, kind: str, now: float) -> None:
+        self._timeouts += 1
+        if kind == "ttft":
+            self._timeouts_ttft += 1
+        rec.timeout_kind = kind
+        self._retire(rec, "timeout", now)
+
+    def _deadline_kind(self, rec: _Rec, now: float) -> Optional[str]:
+        r = rec.req
+        waited = now - rec.submit_t
+        if (r.ttft_deadline_s > 0 and rec.first_token_t is None
+                and waited >= r.ttft_deadline_s):
+            return "ttft"
+        if r.deadline_s > 0 and waited >= r.deadline_s:
+            return "total"
+        return None
+
+    def _sweep_deadlines(self) -> None:
+        """Evict every request past its deadline — queued, mid-prefill or
+        decoding alike (the freed slot is reusable this same tick). An
+        abandoned mid-prefill slot leaves only stale device state the
+        next admission resets."""
+        now = self.clock()
+        for rec in [rec for rec in self._queue
+                    if self._deadline_kind(rec, now)]:
+            self._queue.remove(rec)
+            self._timeout(rec, self._deadline_kind(rec, now), now)
+        rec = self._admitting
+        if rec is not None:
+            kind = self._deadline_kind(rec, now)
+            if kind:
+                self._admitting = None
+                self._timeout(rec, kind, now)
+        for rec in list(self._running.values()):
+            kind = self._deadline_kind(rec, now)
+            if kind:
+                self._timeout(rec, kind, now)
+
+    # ------------------------------------------------------ quarantine drain
+
+    def evict_for_requeue(self) -> list:
+        """Vacate every in-flight request (queued + admitting + running)
+        for re-admission elsewhere — the Router's quarantine drain. The
+        records are returned in SUBMIT order (deterministic re-routing),
+        marked ``requeued`` here as tombstones; their prefix pins are
+        released (host-side index work — safe against a wedged engine),
+        tokens are cleared (survivors regenerate the full deterministic
+        stream), and every slot is freed. The engine's device state needs
+        no touch: stale slots are masked spectators until re-admission
+        resets them."""
+        recs = list(self._queue)
+        if self._admitting is not None:
+            recs.append(self._admitting)
+        recs += list(self._running.values())
+        recs.sort(key=lambda r: r.rid)
+        self._queue.clear()
+        self._admitting = None
+        self._running.clear()
+        self._free = list(range(self.engine.n_slots))
+        for rec in recs:
+            if rec.handle is not None:
+                try:
+                    self.engine.release_prefix(rec.handle)
+                except Exception:  # noqa: BLE001 — draining a broken
+                    pass           # replica must not fail the requeue
+                rec.handle = None
+            rec.pages_loaded = 0
+            rec.slot = -1
+            rec.tokens = []
+            rec.status = "requeued"
+            self._requeued_out += 1
+        return recs
 
     def release(self, rid: int) -> None:
         """Drop a completed request's record (tokens included) — call after
@@ -393,7 +619,12 @@ class Scheduler:
                 "queue_depth": len(self._queue),
                 "occupancy": round(self._occupancy(), 4),
                 "slot_ages_s": slot_ages,
-                "completed": self._completed}
+                "completed": self._completed,
+                "shed": self._shed,
+                "timeouts": self._timeouts,
+                "request_errors": self._request_errors,
+                "requeued_out": self._requeued_out,
+                "requeued_in": self._requeued_in}
 
     # --------------------------------------------------------------- metrics
 
@@ -411,6 +642,12 @@ class Scheduler:
             return out
         out.update({
             "serve_ticks": float(self._tick),
+            "serve_shed": float(self._shed),
+            "serve_timeouts": float(self._timeouts),
+            "serve_timeouts_ttft": float(self._timeouts_ttft),
+            "serve_request_errors": float(self._request_errors),
+            "serve_requeued_out": float(self._requeued_out),
+            "serve_requeued_in": float(self._requeued_in),
             "serve_queue_peak": float(self._queue_peak),
             "serve_occupancy_mean": (self._occupancy_sum / self._tick
                                      if self._tick else 0.0),

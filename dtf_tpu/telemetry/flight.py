@@ -125,14 +125,18 @@ class FlightRecorder:
 
     # ------------------------------------------------------------ heartbeat
 
-    def write_heartbeat(self, *, stalled: bool = False) -> None:
+    def write_heartbeat(self, *, stalled: bool = False,
+                        extra: Optional[Mapping] = None) -> None:
         """One atomic liveness record (tmp + rename so the controller can
         never read a torn write). Host facts only, never raises — it runs
         on the watchdog thread against a possibly-wedged backend. A wedged
         loop keeps heartbeating (the thread is alive) with ``stalled:
         true`` and a frozen ``step`` — exactly the signature the
         controller's run-wedged verdict keys on; a SIGKILL'd host simply
-        stops writing."""
+        stops writing. ``extra`` merges caller facts into the record —
+        the serve tier's :class:`dtf_tpu.serve.client.Heartbeat` stamps
+        its fleet panel (completed/queue/quarantines) here so a serving
+        process exposes the same liveness surface as a trainer."""
         path = self.heartbeat_path
         if not path:
             return
@@ -140,6 +144,8 @@ class FlightRecorder:
             step = self.records[-1]["step"] if self.records else None
         rec = {"t": round(self.wall(), 3), "pid": os.getpid(),
                "step": step, "stalled": bool(stalled)}
+        if extra:
+            rec.update(extra)
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             tmp = f"{path}.tmp.{os.getpid()}"

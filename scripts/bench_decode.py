@@ -149,9 +149,11 @@ def child_serve():
     import numpy as np
 
     from _dtf_watchdog import fence
+    from dtf_tpu.fault.inject import ServeFaultPlan
     from dtf_tpu.models import gpt
-    from dtf_tpu.serve import (DecodeEngine, PoissonLoadGen, Router,
-                               Scheduler, replay)
+    from dtf_tpu.serve import (DecodeEngine, HealthConfig, PoissonLoadGen,
+                               Router, Scheduler, install_serve_fault,
+                               replay)
     from dtf_tpu.serve.scheduler import _quantile
 
     tiny = os.environ.get("DTF_DECODE_TINY") == "1"
@@ -196,7 +198,16 @@ def child_serve():
         raise SystemExit(f"n_slots={n_slots} not divisible by "
                          f"replicas={replicas}")
 
-    def serve_side(prefix_on):
+    # the degraded-fleet A/B (ISSUE 12): with a serve fault plan in the
+    # env, the row grows a "serve_degraded" side — same seeded arrivals,
+    # health watchdog on, one replica wedged at a seeded tick — so
+    # goodput / TTFT p99 / shed fraction under quarantine+requeue sit
+    # next to the fault-free side. Both sides get the same bounded queue
+    # so shed pressure is comparable.
+    fault_plan = ServeFaultPlan.from_env()
+    fault_queue = n_slots if fault_plan is not None else 0
+
+    def serve_side(prefix_on, inject=False):
         pool = (max_len // page) * 2 if prefix_on else 0
         engines = [DecodeEngine(base, params, n_slots=n_slots // replicas,
                                 max_len=max_len, prefill_chunk=chunk,
@@ -215,12 +226,29 @@ def child_serve():
             e.warm_page_programs()
             for k in e.counters:
                 e.counters[k] = 0
+        health = (HealthConfig(slow_factor=8.0, min_slow_s=0.2,
+                               wedge_s=0.5, quarantine_after=2,
+                               probation_delay_s=3600.0)
+                  if fault_plan is not None and replicas > 1 else False)
         if replicas > 1:
-            sched = Router(engines, None, prefill_chunks_per_tick=4)
+            sched = Router(engines, None, prefill_chunks_per_tick=4,
+                           health=health, max_queue=fault_queue)
         else:
-            sched = Scheduler(engines[0], None, prefill_chunks_per_tick=4)
+            sched = Scheduler(engines[0], None, prefill_chunks_per_tick=4,
+                              max_queue=fault_queue)
+        if inject:
+            # wedge sleeps are real wall time (the watchdog quarantines
+            # on measured tick duration); installed AFTER warm-up so the
+            # warm decode calls don't consume the seeded tick budget
+            install_serve_fault(fault_plan, sched)
         wall = replay(sched, arrivals)
-        goodput = sum(len(sched.poll(r)["tokens"]) for r in range(n_req))
+        polls = [sched.poll(r) for r in range(n_req)]
+        statuses = {}
+        for p in polls:
+            statuses[p["status"]] = statuses.get(p["status"], 0) + 1
+        # goodput counts DELIVERED work only: tokens of done requests
+        goodput = sum(len(p["tokens"]) for p in polls
+                      if p["status"] == "done")
         st = sched.stats()
         if replicas > 1:
             ttft50, ttft99 = st["router_ttft_p50_s"], st["router_ttft_p99_s"]
@@ -233,19 +261,30 @@ def child_serve():
         for e in engines:
             for k, v in e.counters.items():
                 counters[k] = counters.get(k, 0) + v
-        return {"tokens_per_sec": round(goodput / max(wall, 1e-9), 1),
-                "makespan_s": round(wall, 3),
-                "ttft_p50_s": round(ttft50, 5),
-                "ttft_p99_s": round(ttft99, 5),
-                "occupancy_mean": round(occ, 3),
-                "prefill_chunks": counters["prefill_chunks"],
-                "pages_loaded": counters["pages_loaded"],
-                "pages_saved": counters["pages_saved"],
-                "prefix_hit_tokens": counters["prefix_hit_tokens"]}
+        out = {"tokens_per_sec": round(goodput / max(wall, 1e-9), 1),
+               "makespan_s": round(wall, 3),
+               "ttft_p50_s": round(ttft50, 5),
+               "ttft_p99_s": round(ttft99, 5),
+               "occupancy_mean": round(occ, 3),
+               "prefill_chunks": counters["prefill_chunks"],
+               "pages_loaded": counters["pages_loaded"],
+               "pages_saved": counters["pages_saved"],
+               "prefix_hit_tokens": counters["prefix_hit_tokens"]}
+        if fault_plan is not None:
+            shed = st.get("router_shed", st.get("serve_shed", 0.0))
+            out["statuses"] = statuses
+            out["shed_frac"] = round(shed / n_req, 4)
+            out["timeouts"] = st.get("router_timeouts",
+                                     st.get("serve_timeouts", 0.0))
+            out["quarantines"] = st.get("router_quarantines", 0.0)
+            out["requeued"] = st.get("router_requeued", 0.0)
+        return out
 
     # ---- serve side: open-loop Poisson against the engine/router fleet
     serve = serve_side(prefix_on=hit_ratio > 0)
     serve_off = serve_side(prefix_on=False) if hit_ratio > 0 else None
+    serve_degraded = (serve_side(prefix_on=hit_ratio > 0, inject=True)
+                      if fault_plan is not None else None)
 
     # ---- static side: same arrivals, fixed batches, worst-case decode.
     # TTFT for a static server is delivery time: batch end - arrival (a
@@ -287,6 +326,12 @@ def child_serve():
         # the in-row prefix A/B: same arrivals, page cache off — TTFT p50
         # must improve and prefill_chunks strictly drop on the ON side
         row["serve_off"] = serve_off
+    if serve_degraded is not None:
+        # the degraded-fleet A/B: one replica wedged at a seeded tick,
+        # quarantine + requeue on; goodput / TTFT p99 / shed fraction
+        # sit next to the fault-free "serve" side above
+        row["fault"] = os.environ.get("DTF_FAULT_INJECT", "")
+        row["serve_degraded"] = serve_degraded
     print(SENTINEL + json.dumps(row))
 
 
@@ -335,6 +380,11 @@ def main(key="decode"):
             {"DTF_SERVE_PREFIX": "0.75"},             # prefix cache A/B
             {"DTF_SERVE_REPLICAS": "2"},              # routing A/B
             {"DTF_SERVE_REPLICAS": "2", "DTF_SERVE_PREFIX": "0.75"},
+            # degraded-fleet A/B (ISSUE 12): one replica wedged at a
+            # seeded decode tick — quarantine + requeue vs fault-free,
+            # goodput/TTFT p99/shed fraction both sides in one row
+            {"DTF_SERVE_REPLICAS": "2",
+             "DTF_FAULT_INJECT": "wedge_replica@6:replica=1"},
         ]
         rows, errors = run_budgeted_jobs(
             serve_jobs, child_argv(os.path.abspath(__file__)) + ["--serve"],

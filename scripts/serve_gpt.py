@@ -71,6 +71,29 @@ flags.DEFINE_integer("prefix_pages", 0, "prefix KV page-pool size per "
 flags.DEFINE_float("ttft_slo", 0.0, "TTFT objective in seconds (0 = "
                    "untracked): the JSON line reports per-replica and "
                    "fleet compliance fractions")
+flags.DEFINE_integer("max_queue", 0, "bounded-queue admission control "
+                     "per replica: a submit against a full queue is SHED "
+                     "(terminal status + retry_after_s hint) instead of "
+                     "queueing forever (0 = unbounded)")
+flags.DEFINE_float("ttft_deadline", 0.0, "per-request TTFT deadline in "
+                   "seconds (0 = none): a request still waiting for its "
+                   "first token past this is evicted with status "
+                   "'timeout'")
+flags.DEFINE_float("deadline", 0.0, "per-request TOTAL deadline in "
+                   "seconds (0 = none); measured from submit")
+flags.DEFINE_boolean("health", True, "with --replicas > 1: per-replica "
+                     "health watchdog (wedged/slow replicas are "
+                     "quarantined, their in-flight requests requeued "
+                     "onto survivors, probation re-admits; "
+                     "docs/RESILIENCE.md 'Serving')")
+flags.DEFINE_float("health_slow_s", 0.0, "health watchdog: min slow-tick "
+                   "bar in seconds (0 = library default)")
+flags.DEFINE_float("health_wedge_s", 0.0, "health watchdog: single-tick "
+                   "wedge bar in seconds — one tick this slow "
+                   "quarantines outright (0 = library default)")
+flags.DEFINE_float("health_probation_s", 0.0, "health watchdog: "
+                   "quarantine→probation delay in seconds (0 = library "
+                   "default)")
 flags.DEFINE_string("requests", "", "semicolon-separated comma-lists of "
                     "token ids; empty = Poisson load")
 flags.DEFINE_integer("n_new", 32, "max new tokens per explicit request")
@@ -166,10 +189,12 @@ def main(argv):
                               kv_cache_dtype=decode_cfg["kv_cache_dtype"])
 
     ckpt = Checkpointer(ckpt_dir)
-    step = ckpt.latest_step()
-    if step is None:
+    if ckpt.latest_step() is None:
         raise app.UsageError(f"no checkpoint under {ckpt_dir}")
-    params = ckpt.restore_params(step)
+    # guarded latest-step restore: a corrupt newest checkpoint WARNs and
+    # serves the next older readable step instead of dying at startup
+    params = ckpt.restore_params()
+    step = ckpt.last_restored_step
     print(f"restored params of step {step} from {ckpt_dir}",
           file=sys.stderr)
     if sharded:
@@ -202,23 +227,48 @@ def main(argv):
         tel.start()
     writer = MetricWriter(None, also_log=False)
     if FLAGS.replicas > 1:
-        from dtf_tpu.serve import Router
+        from dtf_tpu.serve import HealthConfig, Router
 
+        health = False
+        if FLAGS.health:
+            overrides = {}
+            if FLAGS.health_slow_s > 0:
+                overrides["min_slow_s"] = FLAGS.health_slow_s
+            if FLAGS.health_wedge_s > 0:
+                overrides["wedge_s"] = FLAGS.health_wedge_s
+            if FLAGS.health_probation_s > 0:
+                overrides["probation_delay_s"] = FLAGS.health_probation_s
+            health = HealthConfig(**overrides)
         sched = Router(
             engines, writer, telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
+            health=health, max_queue=FLAGS.max_queue,
             prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
     else:
         sched = Scheduler(
             engines[0], writer, log_every=0,
             prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
-            telemetry=tel, ttft_slo_s=FLAGS.ttft_slo)
+            telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
+            max_queue=FLAGS.max_queue)
+
+    # serve-side chaos (DTF_FAULT_INJECT=wedge_replica@tick:replica=k |
+    # slow_decode@tick | poison_request@n) rides the launcher the way
+    # PR 11's verbs ride the trainers — the chaos matrix drives this.
+    from dtf_tpu.fault.inject import ServeFaultPlan
+
+    fault_plan = ServeFaultPlan.from_env()
+    if fault_plan is not None:
+        from dtf_tpu.serve import install_serve_fault
+
+        install_serve_fault(fault_plan, sched)
 
     heartbeat = None
     if FLAGS.stats_every:
         from dtf_tpu.serve import Heartbeat
 
         heartbeat = Heartbeat(sched, every_ticks=FLAGS.stats_every,
-                              slo_floor=FLAGS.ttft_slo_frac)
+                              slo_floor=FLAGS.ttft_slo_frac,
+                              flight=tel.flight if tel is not None
+                              else None)
     on_tick = heartbeat.maybe_emit if heartbeat is not None else None
 
     eos = FLAGS.eos_id if FLAGS.eos_id >= 0 else None
@@ -237,7 +287,9 @@ def main(argv):
                     prompt=prompt, max_new=FLAGS.n_new,
                     temperature=FLAGS.temperature, top_k=FLAGS.top_k,
                     top_p=FLAGS.top_p, eos_id=eos, pad_id=FLAGS.pad_id,
-                    seed=FLAGS.seed + i)))
+                    seed=FLAGS.seed + i,
+                    ttft_deadline_s=FLAGS.ttft_deadline,
+                    deadline_s=FLAGS.deadline)))
             except ValueError as e:   # over-long prompt / bad n_new
                 raise app.UsageError(f"request {i}: {e}")
         sched.run_until_idle(on_tick=on_tick)
@@ -258,7 +310,12 @@ def main(argv):
                 top_p=FLAGS.top_p, eos_id=eos, seed=FLAGS.seed)
         except ValueError as e:  # rate/prompt/new bound flag errors
             raise app.UsageError(str(e))
-        replay(sched, gen.arrivals(), on_tick=on_tick)
+        arrivals = gen.arrivals()
+        if FLAGS.ttft_deadline > 0 or FLAGS.deadline > 0:
+            arrivals = ((t, dataclasses.replace(
+                req, ttft_deadline_s=FLAGS.ttft_deadline,
+                deadline_s=FLAGS.deadline)) for t, req in arrivals)
+        replay(sched, arrivals, on_tick=on_tick)
         rids = list(range(FLAGS.n_requests))   # submit order = id order
     wall = time.perf_counter() - t0
 
@@ -266,11 +323,18 @@ def main(argv):
         for rid in rids:
             st = sched.poll(rid)
             print(f"{rid}:" + ",".join(str(t) for t in st["tokens"]))
-    n_tokens = sum(len(sched.poll(r)["tokens"]) for r in rids)
+    polls = [sched.poll(r) for r in rids]
+    statuses: dict = {}
+    for p in polls:
+        statuses[p["status"]] = statuses.get(p["status"], 0) + 1
+    n_tokens = sum(len(p["tokens"]) for p in polls)
     cache_bytes = sum(e.cache_bytes() for e in engines)
     out = {"mode": "requests" if FLAGS.requests else "poisson",
            "backend": jax.default_backend(), "step": step,
            "replicas": FLAGS.replicas,
+           "request_statuses": statuses,
+           "fault_inject": os.environ.get("DTF_FAULT_INJECT", "")
+           if fault_plan is not None else "",
            "n_slots": FLAGS.n_slots, "max_len": FLAGS.max_len,
            "prefill_chunk": FLAGS.prefill_chunk,
            "kv_page_size": FLAGS.kv_page_size if FLAGS.prefix_pages else 0,
@@ -282,7 +346,9 @@ def main(argv):
     out.update({k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in sched.stats().items()})
     if heartbeat is not None:
-        out["heartbeats"] = heartbeat.emitted
+        # heartbeats + SLO-excursion count + worst compliance fraction:
+        # a run that breached and recovered must not look clean
+        out.update(heartbeat.stats())
     if tel is not None:
         if FLAGS.trace_out and tel.tracer is not None:
             from dtf_tpu.telemetry.profile import export_chrome_trace

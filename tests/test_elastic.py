@@ -692,6 +692,61 @@ def test_restore_wrong_target_raises_immediately_not_corruption(
     fresh.close()
 
 
+def test_restore_params_falls_back_past_corrupt_newest(tmp_path, caplog):
+    """ISSUE 12 satellite: serving restore gets PR 11's fallback parity —
+    a truncated newest checkpoint WARNs and serves the next older
+    readable step's params instead of killing serving startup; an
+    explicitly requested step still gets no fallback, and all-corrupt
+    fails loudly."""
+    d = str(tmp_path / "ck")
+    ckpt = Checkpointer(d, async_save=False)
+    for s in (1, 2):
+        ckpt.save(s, {"params": {"w": jnp.arange(8.0) * s}, "step": s},
+                  force=True)
+    ckpt.wait()
+    ckpt.close()
+    info = corrupt_latest_checkpoint(d)
+    assert info["step"] == 2 and info["files"]
+
+    fresh = Checkpointer(d, async_save=False)
+    with caplog.at_level("WARNING", logger="dtf_tpu"):
+        params = fresh.restore_params()
+    assert fresh._last_restored_step == 1
+    np.testing.assert_array_equal(np.asarray(params["w"]), np.arange(8.0))
+    assert any("unreadable" in r.message for r in caplog.records)
+    # explicit-step requests get NO fallback — the caller asked for 2
+    with pytest.raises(Exception):
+        fresh.restore_params(2)
+    fresh.close()
+
+    # every step corrupt → loud failure naming the walk
+    again = Checkpointer(d, async_save=False)
+    for root, _, files in os.walk(os.path.join(d, "1")):
+        for name in files:     # damage the remaining readable step too
+            p = os.path.join(root, name)
+            if os.path.getsize(p) > 0:
+                with open(p, "r+b") as f:
+                    f.truncate(os.path.getsize(p) // 2)
+    with pytest.raises(RuntimeError, match="every checkpoint step"):
+        again.restore_params()
+    again.close()
+
+
+def test_restore_params_wrong_target_raises_immediately(tmp_path):
+    """A checkpoint with no params subtree (not a TrainState) re-raises
+    as itself instead of walking history into a bogus all-corrupt
+    story."""
+    d = str(tmp_path / "ck")
+    ckpt = Checkpointer(d, async_save=False)
+    ckpt.save(1, {"w": jnp.ones((4,))}, force=True)   # legacy, no params
+    ckpt.wait()
+    ckpt.close()
+    fresh = Checkpointer(d, async_save=False)
+    with pytest.raises(ValueError, match="'params' subtree"):
+        fresh.restore_params()
+    fresh.close()
+
+
 def test_restore_all_corrupt_fails_loudly(tmp_path):
     d = str(tmp_path / "ck")
     ckpt = Checkpointer(d, async_save=False)

@@ -1,0 +1,530 @@
+"""Serve-tier resilience (ISSUE 12, tier-1 fast): the replica health state
+machine, per-request deadlines, bounded-queue load shedding, terminal
+poll/result statuses, poison isolation, and the seeded serve fault smoke —
+forced quarantine → requeue → BITWISE survivor token identity on real tiny
+engines with ``trace_counts`` still pinned {prefill: 1, decode: 1}.
+
+Everything host-timed runs on injectable clocks (no sleeps); the real-sleep
+chaos matrix lives in tests/test_serve_chaos.py (slow tier).
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from dtf_tpu.fault.inject import FaultPlan, ServeFaultPlan
+from dtf_tpu.serve import (Heartbeat, Request, RequestFailed, Router,
+                           Scheduler, ServeClient, install_serve_fault)
+from dtf_tpu.serve.health import (DEGRADED, HEALTHY, PROBATION, QUARANTINED,
+                                  HealthConfig, HealthTracker)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _FakeEngine:
+    """Host-only engine: every prompt is one chunk (first token =
+    prompt[0] % 7), decode emits 1s — deterministic, so requeue identity
+    is checkable without a backend."""
+
+    n_slots = 2
+    max_len = 64
+    prefill_chunk = 64
+
+    def prefill_chunk_into(self, slot, prompt, chunk_i, *, start=0, **kw):
+        return int(prompt[0]) % 7, False
+
+    def decode(self, **kw):
+        return [1] * self.n_slots, [False] * self.n_slots
+
+
+# ---------------------------------------------------------------------------
+# HealthTracker state machine (pure host, injectable clock)
+# ---------------------------------------------------------------------------
+
+def _tracker(clk, **kw):
+    cfg = dict(slow_factor=5.0, min_slow_s=1.0, wedge_s=5.0,
+               quarantine_after=2, probation_delay_s=50.0,
+               probation_ticks=2)
+    cfg.update(kw)
+    return HealthTracker(2, HealthConfig(**cfg), clock=clk)
+
+
+def test_health_strikes_degrade_then_quarantine_then_probation():
+    clk = _Clock()
+    tr = _tracker(clk)
+    assert tr.note_tick(0, 0.1) is None                  # healthy tick
+    assert tr.note_tick(0, 1.5) == DEGRADED              # strike 1
+    assert tr.note_tick(0, 1.5) == QUARANTINED           # strike 2
+    assert not tr.routable(0)
+    clk.advance(49.0)
+    assert not tr.routable(0)                            # delay not elapsed
+    clk.advance(2.0)
+    assert tr.routable(0) and tr.state(0) == PROBATION   # lazy flip
+    assert tr.note_tick(0, 0.1) is None                  # 1 clean tick
+    assert tr.note_tick(0, 0.1) == HEALTHY               # re-admitted
+    assert tr.counters["readmits"] == 1
+    assert tr.counters["quarantines"] == 1
+    # a clean tick after a single strike recovers degraded → healthy
+    assert tr.note_tick(0, 1.5) == DEGRADED
+    assert tr.note_tick(0, 0.1) == HEALTHY
+
+
+def test_health_wedge_bar_quarantines_on_one_tick_and_backoff_doubles():
+    clk = _Clock()
+    tr = _tracker(clk)
+    assert tr.note_tick(1, 9.0) == QUARANTINED           # >= wedge_s
+    clk.advance(60.0)
+    assert tr.routable(1)                                # probation
+    assert tr.note_tick(1, 9.0) == QUARANTINED           # failed probation
+    assert tr._r[1].delay_s == 100.0                     # 50 * backoff 2
+    assert tr.quarantined_eta_s() == 100.0
+    clk.advance(40.0)
+    assert tr.quarantined_eta_s() == 60.0
+
+
+def test_health_adaptive_bar_excludes_slow_ticks_from_baseline():
+    clk = _Clock()
+    tr = _tracker(clk, min_slow_s=0.01, slow_factor=10.0, wedge_s=100.0,
+                  quarantine_after=3)
+    for _ in range(8):
+        tr.note_tick(0, 0.005)
+    bar = tr.threshold_s(0)
+    assert bar == pytest.approx(0.05)                    # 10 x p99(0.005)
+    # a slow tick must NOT raise its own bar for the next verdicts
+    assert tr.note_tick(0, 10.0) == DEGRADED
+    assert tr.threshold_s(0) == pytest.approx(bar)
+
+
+def test_health_config_validation():
+    with pytest.raises(ValueError, match="degrade_after"):
+        HealthConfig(degrade_after=3, quarantine_after=2)
+    with pytest.raises(ValueError, match="probation_ticks"):
+        HealthConfig(probation_ticks=0)
+    with pytest.raises(ValueError, match="wedge_s"):
+        HealthConfig(min_slow_s=5.0, wedge_s=1.0)
+    with pytest.raises(ValueError, match="probation_backoff"):
+        HealthConfig(probation_backoff=0.5)
+
+
+# ---------------------------------------------------------------------------
+# Serve fault plans (DTF_FAULT_INJECT grammar, family routing)
+# ---------------------------------------------------------------------------
+
+def test_serve_fault_plan_parse_and_env_routing():
+    p = ServeFaultPlan.parse("wedge_replica@6:replica=1")
+    assert (p.kind, p.tick, p.replica) == ("wedge_replica", 6, 1)
+    assert ServeFaultPlan.parse("poison_request@2").replica is None
+    with pytest.raises(ValueError, match="needs"):
+        ServeFaultPlan.parse("slow_decode")
+    with pytest.raises(ValueError, match="unknown serve fault kind"):
+        ServeFaultPlan.parse("explode@3")
+    with pytest.raises(ValueError, match="unknown serve fault option"):
+        ServeFaultPlan.parse("slow_decode@3:host=1")
+    # the two families ride the SAME env var and skip each other
+    env = {"DTF_FAULT_INJECT": "wedge_replica@2:replica=1"}
+    assert FaultPlan.from_env(env=env) is None
+    assert ServeFaultPlan.from_env(env=env).kind == "wedge_replica"
+    env = {"DTF_FAULT_INJECT": "kill@12:host=1"}
+    assert FaultPlan.from_env(env=env).kind == "kill"
+    assert ServeFaultPlan.from_env(env=env) is None
+    assert ServeFaultPlan.from_env(env={}) is None
+
+
+# ---------------------------------------------------------------------------
+# Deadlines + shed + terminal statuses (fake engine, fake clock)
+# ---------------------------------------------------------------------------
+
+def test_deadline_eviction_ttft_and_total():
+    clk = _Clock()
+    eng = _FakeEngine()
+    eng.n_slots = 1
+    sched = Scheduler(eng, clock=clk, prefill_chunks_per_tick=1)
+    a = sched.submit(Request(prompt=[3], max_new=50))
+    sched.tick()                              # a holds the only slot
+    c = sched.submit(Request(prompt=[5], max_new=50, ttft_deadline_s=5.0))
+    clk.advance(10.0)
+    sched.tick()                              # c TTFT-expired while queued
+    pc = sched.poll(c)
+    assert pc == {"status": "timeout", "tokens": [], "timeout_kind": "ttft"}
+    # total deadline fires MID-DECODE and frees the slot for reuse
+    e = sched.submit(Request(prompt=[2], max_new=50, deadline_s=20.0))
+    for _ in range(3):
+        sched.tick()
+    assert sched.poll(e)["status"] in ("queued", "prefill", "running")
+    clk.advance(30.0)
+    sched.tick()
+    pe = sched.poll(e)
+    assert pe["status"] == "timeout" and pe["timeout_kind"] == "total"
+    st = sched.stats()
+    assert st["serve_timeouts"] == 2.0 and st["serve_timeouts_ttft"] == 1.0
+    # the freed slots still serve: a fresh request completes
+    f = sched.submit(Request(prompt=[6], max_new=2))
+    sched.run_until_idle()
+    assert sched.poll(f)["status"] == "done"
+    # a TTFT deadline is satisfied by the first token: a running request
+    # with only a ttft bound never times out afterwards
+    assert sched.poll(a)["status"] == "done"
+
+
+def test_shed_bounded_queue_with_retry_after_and_result_raises():
+    clk = _Clock()
+    eng = _FakeEngine()
+    eng.n_slots = 1
+    client = ServeClient(eng, clock=clk, max_queue=1,
+                         prefill_chunks_per_tick=1)
+    a = client.submit([3], max_new=50)
+    client.step()                             # a occupies the slot
+    b = client.submit([4], max_new=50)        # queued (depth 1 = bound)
+    d = client.submit([6], max_new=50)        # full -> shed at submit
+    pd = client.poll(d)
+    assert pd["status"] == "shed" and pd["retry_after_s"] > 0
+    with pytest.raises(RequestFailed) as ei:
+        client.result(d)                      # immediate — no tick spin
+    assert ei.value.status == "shed" and "retry after" in str(ei.value)
+    st = client.stats()
+    assert st["serve_shed"] == 1.0
+    # shed requests never entered the queue: peak respects the bound
+    assert st["serve_queue_peak"] <= 1.0
+    del a, b
+
+
+def test_scheduler_rejects_negative_max_queue():
+    with pytest.raises(ValueError, match="max_queue"):
+        Scheduler(_FakeEngine(), max_queue=-1)
+
+
+def test_poison_request_isolates_to_one_request():
+    clk = _Clock()
+    client = ServeClient(_FakeEngine(), clock=clk)
+    sched = client.scheduler
+    plan = ServeFaultPlan.parse("poison_request@1")
+    state = install_serve_fault(plan, sched, sleep=clk.advance,
+                                emit=lambda line: None)
+    r0 = sched.submit(Request(prompt=[1], max_new=2))
+    r1 = sched.submit(Request(prompt=[2], max_new=2))
+    r2 = sched.submit(Request(prompt=[3], max_new=2))
+    sched.run_until_idle()
+    assert state.fired
+    p1 = sched.poll(r1)
+    assert p1["status"] == "error" and "InjectedPoison" in p1["error"]
+    assert sched.poll(r0)["status"] == "done"
+    assert sched.poll(r2)["status"] == "done"      # replica kept serving
+    assert sched.stats()["serve_request_errors"] == 1.0
+    with pytest.raises(RequestFailed, match="terminally error"):
+        client.result(r1)
+
+
+# ---------------------------------------------------------------------------
+# Router: wedge → quarantine → requeue (fakes), front-door shed
+# ---------------------------------------------------------------------------
+
+def _fake_router(clk, **health_kw):
+    cfg = dict(slow_factor=5.0, min_slow_s=1.0, wedge_s=5.0,
+               probation_delay_s=1000.0)
+    cfg.update(health_kw)
+    return Router([_FakeEngine(), _FakeEngine()], clock=clk,
+                  health=HealthConfig(**cfg))
+
+
+def test_router_wedge_quarantines_and_requeues_with_identity():
+    clk = _Clock()
+    router = _fake_router(clk)
+    plan = ServeFaultPlan.parse("wedge_replica@2:replica=1")
+    state = install_serve_fault(plan, router, sleep=clk.advance,
+                                wedge_s=10.0, emit=lambda line: None)
+    rids = [router.submit(Request(prompt=[i + 1], max_new=4))
+            for i in range(6)]
+    router.drain()
+    assert state.fired
+    st = router.stats()
+    assert st["router_quarantines"] == 1.0
+    assert st["router_requeued"] >= 1.0
+    assert st["replica1_health"] == QUARANTINED
+    assert st["router_completed"] == 6.0
+    # fake tokens are deterministic: a fault-free fleet gives the same
+    clean = Router([_FakeEngine(), _FakeEngine()], clock=_Clock(),
+                   health=False)
+    crids = [clean.submit(Request(prompt=[i + 1], max_new=4))
+             for i in range(6)]
+    clean.drain()
+    assert ([router.result(r) for r in rids]
+            == [clean.result(r) for r in crids])
+    # the wedged engine is never ticked again: pump stays fast (clock
+    # only advanced by the strike window's wedge sleeps)
+    before = clk.t
+    router.submit(Request(prompt=[9], max_new=4))
+    router.drain()
+    assert clk.t == before
+
+
+def test_router_front_door_shed_when_fleet_quarantined():
+    clk = _Clock()
+    router = Router([_FakeEngine()], clock=clk,
+                    health=HealthConfig(probation_delay_s=42.0))
+    router.quarantine(0, "test")
+    rid = router.submit(Request(prompt=[1], max_new=2))
+    p = router.poll(rid)
+    assert p["status"] == "shed"
+    assert p["retry_after_s"] == 42.0          # honest probation ETA
+    with pytest.raises(RequestFailed):
+        router.result(rid)
+    assert router.stats()["router_shed"] == 1.0
+    router.release(rid)                        # front-door records release
+    with pytest.raises(KeyError):
+        router.poll(rid)
+    # health disabled (default single replica) → quarantine refuses
+    bare = Router([_FakeEngine()])
+    assert bare.health is None
+    with pytest.raises(RuntimeError, match="health is disabled"):
+        bare.quarantine(0)
+
+
+def test_router_health_adds_zero_blocking_readbacks():
+    """Health-on routing (timed ticks + verdicts + stats) casts device
+    outputs exactly as often as health-off — the watchdog is pure host
+    clock arithmetic (PR 5's counter-instrumented idiom)."""
+    class _CastCounter:
+        def __init__(self, v, casts):
+            self.v, self.casts = v, casts
+
+        def __int__(self):
+            self.casts.append("int")
+            return int(self.v)
+
+        def __bool__(self):
+            self.casts.append("bool")
+            return bool(self.v)
+
+    class _CountArr:
+        def __init__(self, vals, casts):
+            self.vals, self.casts = vals, casts
+
+        def __getitem__(self, i):
+            return _CastCounter(self.vals[i], self.casts)
+
+    class _Eng(_FakeEngine):
+        def __init__(self, casts):
+            self.casts = casts
+
+        def decode(self, **kw):
+            return (_CountArr([1] * self.n_slots, self.casts),
+                    _CountArr([False] * self.n_slots, self.casts))
+
+    def run(health):
+        casts = []
+        router = Router([_Eng(casts), _Eng(casts)], clock=_Clock(),
+                        health=health)
+        for i in range(6):
+            router.submit(Request(prompt=[i + 1], max_new=3))
+        router.drain()
+        router.stats()
+        return len(casts)
+
+    off = run(False)
+    on = run(HealthConfig())
+    assert off == on and off > 0, (off, on)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat: excursion counting + worst compliance + flight stamping
+# ---------------------------------------------------------------------------
+
+class _StatsSched:
+    def __init__(self):
+        self.ok = 1.0
+
+    def stats(self):
+        return {"serve_completed": 1.0, "serve_ttft_slo_ok_frac": self.ok}
+
+
+def test_heartbeat_counts_excursions_and_worst_frac(tmp_path):
+    from dtf_tpu.telemetry.flight import FlightRecorder
+
+    clk = _Clock()
+    sched = _StatsSched()
+    lines = []
+    hb_path = str(tmp_path / "heartbeat.json")
+    flight = FlightRecorder(heartbeat_path=hb_path, clock=clk,
+                            wall=lambda: 1000.0)
+    hb = Heartbeat(sched, every_ticks=1, slo_floor=0.9, clock=clk,
+                   emit=lines.append, flight=flight)
+    hb.maybe_emit()                     # ok=1.0 — clean
+    sched.ok = 0.5
+    hb.maybe_emit()                     # excursion 1 enters
+    hb.maybe_emit()                     # sustained — NOT a new excursion
+    sched.ok = 0.95
+    hb.maybe_emit()                     # recovered (re-armed)
+    sched.ok = 0.7
+    hb.maybe_emit()                     # excursion 2
+    assert hb.excursions == 2
+    assert hb.worst_ok_frac == 0.5
+    st = hb.stats()
+    assert st["slo_excursions"] == 2.0
+    assert st["worst_ttft_slo_ok_frac"] == 0.5
+    assert st["heartbeats"] == 5.0 == float(len(lines))
+    # the flight heartbeat file carries the serve panel atomically
+    beat = json.loads(open(hb_path).read())
+    assert beat["serve"]["serve_completed"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 serve fault smoke: REAL tiny engines, forced quarantine →
+# requeue → bitwise survivor token identity, trace_counts pinned.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt_params():
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.models import gpt
+
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32)
+    model = gpt.GPT(dataclasses.replace(cfg, decode_len=48))
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 1), jnp.int32))["params"]
+    return cfg, model, params
+
+
+def _offline(model, params, req):
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.models import gpt
+
+    out = gpt.generate(
+        model, params, jnp.asarray([req["prompt"]], jnp.int32),
+        req["max_new"], rng=jax.random.PRNGKey(req.get("seed", 0)),
+        temperature=req.get("temperature", 0.0))
+    return np.asarray(out)[0, len(req["prompt"]):].tolist()
+
+
+def test_serve_fault_smoke_requeue_token_identity(gpt_params):
+    """The seeded serve fault smoke (ISSUE 12 CI satellite): requests
+    in-flight on a quarantined replica replay on the survivor and every
+    completed token stream is BITWISE identical to offline generate() —
+    greedy and seeded sampling alike — with per-replica trace_counts
+    still pinned {prefill: 1, decode: 1} (requeue is host-side
+    resubmission, never a retrace)."""
+    cfg, model, params = gpt_params
+    clk = _Clock()
+    router = Router.build(cfg, params, n_replicas=2, n_slots=2, max_len=48,
+                          prefill_chunk=5, clock=clk,
+                          health=HealthConfig(probation_delay_s=50.0,
+                                              probation_ticks=2))
+    rng = np.random.default_rng(1)
+    reqs = [dict(prompt=rng.integers(0, 128,
+                                     int(rng.integers(1, 14))).tolist(),
+                 max_new=int(rng.integers(2, 9)),
+                 temperature=0.0 if i % 2 else 0.8, seed=40 + i)
+            for i in range(6)]
+    rids = [router.submit(Request(**r)) for r in reqs]
+    for _ in range(3):
+        router.tick()                 # tokens in flight on both replicas
+    router.quarantine(1, "forced")    # drain replica 1 onto the survivor
+    router.drain()
+    for r, rid in zip(reqs, rids):
+        assert router.result(rid) == _offline(model, params, r), r
+    assert router.trace_counts() == [{"prefill": 1, "decode": 1}] * 2
+    st = router.stats()
+    assert st["router_quarantines"] == 1.0
+    assert st["router_requeued"] >= 1.0
+    assert st["replica0_serve_requeued_in"] >= 1.0
+    assert st["replica1_health"] == QUARANTINED
+
+    # probation: after the delay, idle PROBES re-admit the replica (no
+    # live traffic gambled), and later requests still match offline
+    clk.advance(60.0)
+    late = dict(prompt=[7, 8, 9], max_new=4, seed=99)
+    lrid = router.submit(Request(**late))
+    router.drain()
+    assert router.result(lrid) == _offline(model, params, late)
+    st = router.stats()
+    assert st["replica1_health"] == HEALTHY
+    assert st["router_probation_readmits"] == 1.0
+    assert st["router_probe_decodes"] >= 1.0
+    assert router.trace_counts() == [{"prefill": 1, "decode": 1}] * 2
+
+
+def test_probe_observes_wrapped_decode_still_wedged(gpt_params):
+    """Probation probes must route through the instance's ``decode`` —
+    a persistently wedged replica probes SLOW and is re-quarantined with
+    its backoff grown, instead of probing clean through the raw compiled
+    executable and oscillating back into live traffic."""
+    cfg, _, params = gpt_params
+    clk = _Clock()
+    router = Router.build(cfg, params, n_replicas=2, n_slots=2, max_len=48,
+                          prefill_chunk=5, clock=clk,
+                          health=HealthConfig(min_slow_s=1.0, wedge_s=5.0,
+                                              probation_delay_s=50.0))
+    plan = ServeFaultPlan.parse("wedge_replica@0:replica=1")
+    install_serve_fault(plan, router, sleep=clk.advance, wedge_s=10.0,
+                        emit=lambda line: None)
+    rids = [router.submit(Request(prompt=[i + 1], max_new=3))
+            for i in range(4)]
+    router.drain()
+    assert router.stats()["replica1_health"] == QUARANTINED
+    # past the probation delay, with the wedge STILL armed: the probe
+    # pays the wedge once, re-quarantines, and the delay doubles
+    clk.advance(60.0)
+    rid = router.submit(Request(prompt=[9], max_new=3))
+    router.drain()
+    st = router.stats()
+    assert st["replica1_health"] == QUARANTINED
+    assert st["router_quarantines"] == 2.0
+    assert st["router_probation_readmits"] == 0.0
+    assert router.health._r[1].delay_s == 100.0     # backoff grew
+    for r in rids + [rid]:
+        assert router.poll(r)["status"] == "done"
+
+
+def test_ttft_deadline_satisfied_at_clock_zero():
+    """A first token stamped at clock()==0.0 (legitimate with injectable
+    clocks) SATISFIES the TTFT deadline — a falsy-zero check would evict
+    an actively-decoding request as a bogus ttft timeout."""
+    clk = _Clock()                        # t == 0.0 — no advance yet
+    sched = Scheduler(_FakeEngine(), clock=clk, prefill_chunks_per_tick=1)
+    rid = sched.submit(Request(prompt=[3], max_new=20, ttft_deadline_s=1.0))
+    sched.tick()                          # first token lands at t == 0.0
+    assert sched.poll(rid)["tokens"]
+    clk.advance(5.0)                      # far past the TTFT deadline
+    sched.tick()
+    assert sched.poll(rid)["status"] == "running"   # NOT a ttft timeout
+    sched.run_until_idle()
+    assert sched.poll(rid)["status"] == "done"
+    assert sched.stats()["serve_timeouts"] == 0.0
+
+
+def test_requeue_releases_prefix_pins(gpt_params):
+    """Quarantine drain releases the dead replica's page pins (the
+    pages.py refcount contract) — pinned drains to 0, and the requeued
+    request re-prefills via the survivor's own cache unharmed."""
+    cfg, model, params = gpt_params
+    router = Router.build(cfg, params, n_replicas=2, n_slots=2, max_len=48,
+                          prefill_chunk=4, kv_page_size=4, prefix_pages=8,
+                          page_save_after=1, clock=_Clock(),
+                          health=HealthConfig())
+    req = dict(prompt=list(range(1, 13)), max_new=4, seed=3)
+    warm = router.schedulers[1].submit(Request(**req))   # save stem pages
+    router.schedulers[1].run_until_idle()
+    assert router.schedulers[1].poll(warm)["status"] == "done"
+    rid = router.submit(Request(**req))                  # routes to 0
+    hot = router.schedulers[1].submit(Request(**req), trace_id=10_000)
+    router.schedulers[1].tick()      # replica 1 mid-flight, pages pinned
+    router.quarantine(1, "forced")
+    assert router.schedulers[1].engine.prefix_stats()["pinned"] == 0
+    router.drain()
+    assert router.result(rid) == _offline(model, params, req)
+    # the requeued twin (same prompt/seed) matches too
+    assert router.poll(10_000)["tokens"] == _offline(model, params, req)

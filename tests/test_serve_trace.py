@@ -295,9 +295,14 @@ def test_postmortem_dump_carries_serve_context():
     post = tel.dump_postmortem("stall", {"stalled_for_s": 99.0})
     assert len(casts) == n_casts            # dump path: zero device casts
     ctx = post["context"]["serve_router"]
-    flights = [r for rep in ctx.values() for r in rep["in_flight"]]
+    reps = [v for k, v in ctx.items() if k.startswith("replica")]
+    flights = [r for rep in reps for r in rep["in_flight"]]
     assert {f["trace_id"] for f in flights} == {0, 1, 2, 3}
-    assert any(rep["slot_ages_s"] for rep in ctx.values())
+    assert any(rep["slot_ages_s"] for rep in reps)
+    # the ISSUE 12 fleet summary rides next to the replica entries:
+    # requeue/shed counters + per-replica health verdicts
+    assert ctx["router"]["requeued"] == 0
+    assert ctx["router"]["health"] == ["healthy", "healthy"]
 
 
 def test_postmortem_provider_error_never_masks_dump():
