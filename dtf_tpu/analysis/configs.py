@@ -76,6 +76,11 @@ class AnalysisConfig:
     #: config for HBM fit planning (``python -m dtf_tpu.analysis fit``) —
     #: per-slot KV and page-pool bytes are priced from it via eval_shape.
     fit_serve_cfg: Callable[[], Any] | None = None
+    #: speculative serve configs: the REAL-scale DRAFT model config — the
+    #: fit planner then also prices draft params + per-slot draft KV and
+    #: answers "max slots with spec on" (the draft is resident state the
+    #: slot budget must leave room for).
+    fit_draft_cfg: Callable[[], Any] | None = None
 
     def mesh(self, devices=None) -> Mesh:
         return make_mesh(self.mesh_config, devices=devices)
@@ -365,6 +370,47 @@ def _gpt_serve_int8_step(mesh):
     return StepView(step, abs_params, abs_state)
 
 
+def _gpt_serve_spec_step(mesh):
+    """The SPECULATIVE serving tick (ISSUE 13): ``draft_all`` ∘ ``verify``
+    as one step — the two graphs a ``spec_k > 0`` engine compiles beyond
+    prefill. Fences the draft model's unrolled k-step proposal loop and
+    the (k+1)-wide verify pass (its TP all-reduces, per-row span scatter
+    and rollback) so a layout change that turns speculation's one-dispatch
+    win into per-token collective traffic fails tier-1; the memory fields
+    price the k-token verify temp + the draft's resident cache."""
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve.engine import spec_step_view
+
+    step, bundle, ops = spec_step_view(
+        gpt.GPTConfig.tiny(),
+        dataclasses.replace(gpt.GPTConfig.tiny(), layers=1), n_slots=8,
+        max_len=64, spec_k=4, mesh=mesh)
+    return StepView(step, bundle, ops)
+
+
+def _gpt_serve_disagg_step(mesh):
+    """The DISAGGREGATED fleet's prefill-replica admission tick
+    (``prefill_into_slot`` ∘ ``page_save``): the handoff-producing
+    composition — the page pool as prefill→decode KV transport. Fenced so
+    the transport's collective structure (chunk TP projections + the pool
+    scatter over data shards) cannot silently grow into whole-leaf
+    traffic per admission."""
+    from dtf_tpu.models import gpt
+    from dtf_tpu.serve.engine import disagg_step_view
+
+    step, bundle, ops = disagg_step_view(
+        gpt.GPTConfig.tiny(), n_slots=8, max_len=64, prefill_chunk=8,
+        kv_page_size=16, n_pages=4, mesh=mesh)
+    return StepView(step, bundle, ops)
+
+
+def _gpt_draft_real_cfg():
+    """Zero-arg REAL-scale draft-config builder (``fit_draft_cfg``)."""
+    from dtf_tpu.models import gpt
+
+    return gpt.GPTConfig.gpt2_draft()
+
+
 def _gpt_pipe_spec(mesh):
     from dtf_tpu.models import gpt, gpt_pipe
 
@@ -486,6 +532,20 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    _gpt_spec(), _gpt_pages_step,
                    # the prefix-page-cache load/save programs (PR 6) —
                    # one admission tick, fenced like any other program.
+                   allow_dead=(r"w_(in|out)$",),
+                   fit_serve_cfg=_gpt_real_cfg()),
+    AnalysisConfig("gpt_serve_spec", MeshConfig(data=4, model=2),
+                   _gpt_spec(), _gpt_serve_spec_step,
+                   # the speculative tick (draft_all ∘ verify, ISSUE 13)
+                   # at the gpt_serve mesh; fit prices "max slots with
+                   # spec on" from the real draft config.
+                   allow_dead=(r"w_(in|out)$",),
+                   fit_serve_cfg=_gpt_real_cfg(),
+                   fit_draft_cfg=_gpt_draft_real_cfg),
+    AnalysisConfig("gpt_serve_disagg", MeshConfig(data=4, model=2),
+                   _gpt_spec(), _gpt_serve_disagg_step,
+                   # the disaggregated prefill-replica admission tick
+                   # (prefill ∘ page_save — the KV-transport composition).
                    allow_dead=(r"w_(in|out)$",),
                    fit_serve_cfg=_gpt_real_cfg()),
     AnalysisConfig("gpt_pipe", MeshConfig(data=4, pipe=2),

@@ -537,9 +537,31 @@ def _fit_serve(config, hbm_bytes: int, *, max_len: int, kv_page_size: int,
         "max_len": max_len, "kv_page_size": kv_page_size, "kv": {},
     }
     avail = hbm_bytes - params_dev
+
+    # speculative decoding (fit_draft_cfg): the draft model is RESIDENT
+    # state too — its params (priced under the same TP rules) and one
+    # draft KV slot per target slot. "max slots with spec on" is then
+    # answerable before any chip time: the slot budget shrinks by the
+    # draft's per-slot cache and the draft params come off the top.
+    draft_cfg = (config.fit_draft_cfg()
+                 if config.fit_draft_cfg is not None else None)
+    draft_params_dev = 0
+    if draft_cfg is not None:
+        import jax
+
+        from dtf_tpu.models import gpt as gpt_lib
+
+        dmodel = gpt_lib.GPT(draft_cfg, mesh)
+        dparams = jax.eval_shape(lambda: dmodel.init(
+            jax.random.PRNGKey(0),
+            jax.numpy.zeros((1, 1), jax.numpy.int32)))["params"]
+        dspecs = shd.tree_specs(dparams, gpt_lib.tp_rules)
+        draft_params_dev = _price_spec_tree(dparams, dspecs, mesh)
+        out["draft_params_bytes_per_device"] = draft_params_dev
+
     for kv_name in ("bf16", "int8"):
-        cfg = dataclasses.replace(
-            base_cfg, kv_cache_dtype="" if kv_name == "bf16" else "int8")
+        kv_dtype = "" if kv_name == "bf16" else "int8"
+        cfg = dataclasses.replace(base_cfg, kv_cache_dtype=kv_dtype)
         # price data_size slots (one per data shard) so the per-device
         # number is exactly one GLOBAL slot's cost — pricing a single
         # slot would overstate by the data-axis factor (ceil(1/N) = 1).
@@ -560,6 +582,18 @@ def _fit_serve(config, hbm_bytes: int, *, max_len: int, kv_page_size: int,
             left = avail - slots * per_slot
             row["slots"] = slots
             row["max_pages_at_slots"] = max(0, int(left // per_page))
+        if draft_cfg is not None:
+            dstruct = engine_state_struct(
+                dataclasses.replace(draft_cfg, kv_cache_dtype=kv_dtype),
+                n_slots=data_size, max_len=max_len, mesh=mesh)
+            per_slot_draft = tree_device_bytes(dstruct) / data_size
+            savail = avail - draft_params_dev
+            max_spec = (int(savail // (per_slot + per_slot_draft))
+                        if savail > 0 else 0)
+            max_spec -= max_spec % data_size
+            row["draft_kv_bytes_per_slot_per_device"] = int(
+                round(per_slot_draft))
+            row["max_slots_with_spec"] = max_spec
         out["kv"][kv_name] = row
     return out
 

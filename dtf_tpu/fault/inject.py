@@ -52,7 +52,8 @@ KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash")
 #: (:func:`ServeFaultPlan.from_env` +
 #: :func:`dtf_tpu.serve.health.install_serve_fault`) each ignore the
 #: other family's kinds instead of erroring on them.
-SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request")
+SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request",
+               "poison_draft")
 
 
 class InjectedCrash(RuntimeError):

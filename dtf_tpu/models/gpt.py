@@ -167,6 +167,7 @@ class GPTConfig:
         valid names for a typo (callers convert to their UsageError)."""
         sizes = {"small": GPTConfig.gpt2_small,
                  "medium": GPTConfig.gpt2_medium,
+                 "draft": GPTConfig.gpt2_draft,
                  "tiny": GPTConfig.tiny}
         if name not in sizes:
             raise KeyError(
@@ -183,6 +184,15 @@ class GPTConfig:
         matmuls (d_model 1024, d_ff 4096) fill the MXU better than
         small's 768/3072 while params+adam+ZeRO-1 still fit one v5e."""
         return GPTConfig(d_model=1024, layers=24, heads=16, d_ff=4096)
+
+    @staticmethod
+    def gpt2_draft() -> "GPTConfig":
+        """The speculative-decoding DRAFT size (~25M non-embedding):
+        shares the GPT-2 vocab (a draft must propose in the verifier's
+        token space) at a quarter of small's depth and half its width —
+        cheap enough that k proposals cost less than one verifier step,
+        deep enough to track small's greedy stream on natural text."""
+        return GPTConfig(d_model=384, layers=3, heads=6, d_ff=1536)
 
     @staticmethod
     def tiny(**kw) -> "GPTConfig":
@@ -282,6 +292,33 @@ def _cache_put_dyn(cfg, cvar, svar, slot, a) -> None:
             svar.value, s, slot, axis=2)
 
 
+def _cache_put_span(cfg, cvar, svar, positions, a, active, cache_len) -> None:
+    """Per-row multi-position cache write (the slot VERIFY step): batch row
+    b writes ``a[b, :, j, :]`` at its own absolute position
+    ``positions[b, j]`` — slot = position, the full-cache layout this mode
+    requires. Positions at or past the cache end, and every position of an
+    inactive row, are pointed at the out-of-range sentinel and DROPPED
+    (never wrapped): a wrapped write would clobber live early positions
+    with speculative K/V that a rejected tail could not roll back."""
+    b = positions.shape[0]
+    rows = jnp.arange(b)[:, None]                              # [B, 1]
+    drop = positions >= cache_len
+    if active is not None:
+        drop = drop | ~active[:, None]
+    slots = jnp.where(drop, cache_len, positions)              # OOB = drop
+
+    def put(var, upd):                                         # upd [B,H,t,D]
+        var.value = var.value.at[rows, :, slots, :].set(
+            upd.transpose(0, 2, 1, 3), mode="drop")
+
+    if svar is None:
+        put(cvar, a.astype(cfg.dtype))
+    else:
+        q, s = _kv_quant(a)
+        put(cvar, q)
+        put(svar, s)
+
+
 def _cache_put_rows(cfg, cvar, svar, slots, a, active=None) -> None:
     """Per-row single-slot cache write (the ``slot_decode`` step): batch row
     b writes its own slot ``slots[b]`` — the vectorized counterpart of
@@ -370,20 +407,22 @@ class CausalSelfAttention(nn.Module):
         kv_heads = cfg.kv_heads_resolved
         group = cfg.heads // kv_heads
         t = x.shape[1]
-        if cfg.slot_decode and t != 1:
+        if cfg.slot_decode and t != 1 and self.window:
             raise ValueError(
-                "slot_decode steps one token at a time (per-slot cache "
-                "indices); prefill a slot by slicing its row into a "
-                "batch-1 chunked_prefill model (serve/engine.py)")
+                "the slot VERIFY step (slot_decode, multi-token apply) "
+                "needs the full windowless cache layout; "
+                f"attn_window={self.window} rolls the buffer, so a "
+                "rejected speculative tail would clobber live positions "
+                "it cannot roll back")
         if prefill_len is not None and not (
                 cfg.decode_len > 0 and t != 1 and cfg.chunked_prefill):
             raise ValueError(
                 "prefill_len only applies to the chunked-prefill path "
                 "(decode_len > 0, chunked_prefill=True, multi-token chunk)")
-        if decode_active is not None and not (cfg.slot_decode and t == 1):
+        if decode_active is not None and not cfg.slot_decode:
             raise ValueError(
-                "decode_active only applies to the slot_decode step "
-                "(per-row cache indices, single-token apply)")
+                "decode_active only applies to the slot_decode/verify "
+                "steps (per-row cache indices)")
         # ONE projection constructor for every branch (train + decode):
         # comms.TpDense is a drop-in nn.Dense (identical param tree). With
         # --tp_overlap, q/k/v become collective ag_matmuls and attn_out a
@@ -413,6 +452,60 @@ class CausalSelfAttention(nn.Module):
             # head-sharded layouts consistent (shard s's q heads see shard
             # s's repeated kv heads).
             return jnp.repeat(a, group, axis=1) if group > 1 else a
+
+        if cfg.slot_decode and t != 1:
+            # SLOT VERIFY (speculative decoding, serve/engine.py): t tokens
+            # per row — the pending token plus k draft proposals — scored
+            # in ONE batched pass, each row at its OWN cache position.
+            # Position j of a row computes the same formula j sequential
+            # slot_decode steps would: all t K/V land in the cache first
+            # (slot = position; the full-cache layout, enforced above),
+            # every query reads the POST-write cache — like the t=1 branch
+            # reads its own freshly written K (which also keeps int8
+            # self-reads dequantized identically) — and query j's validity
+            # mask is the t=1 formula evaluated at index idx+j. Logits
+            # agree with sequential decode to matmul-shape rounding (the
+            # chunked-prefill parity class — batching t rows reassociates
+            # reductions); the TESTED contract is token-stream identity,
+            # exactly like chunked vs one-shot prefill's decode
+            # continuation. Writes past the cache end DROP (never wrap —
+            # _cache_put_span): their queries' tokens sit past the slot
+            # budget and are never delivered. The caller rolls cache_index
+            # back to the accepted boundary afterwards (cache_rollback);
+            # rejected-tail K/V needs no clearing — validity is derived
+            # from the index.
+            b = x.shape[0]
+            ck, cv, sk, sv, ci, cache_len, is_initialized = self._cache_vars(
+                b, kv_heads, d_head)
+            idx = ci.value                                         # [B]
+            qpos = idx[:, None] + jnp.arange(t)                    # [B, t]
+            q = rope(q, qpos, cfg.rope_theta)
+            k = rope(k, qpos, cfg.rope_theta)
+            if is_initialized:
+                _cache_put_span(cfg, ck, sk, qpos, k,
+                                active=decode_active, cache_len=cache_len)
+                _cache_put_span(cfg, cv, sv, qpos, v,
+                                active=decode_active, cache_len=cache_len)
+                ci.value = (idx + t if decode_active is None
+                            else idx + t * decode_active.astype(jnp.int32))
+            slots = jnp.arange(cache_len)
+            # query j sees slot s iff the t=1 step at index idx+j would:
+            # p_s = newest position <= idx+j congruent to s, valid iff >= 0
+            p_s = qpos[:, :, None] - jnp.remainder(
+                qpos[:, :, None] - slots[None, None, :], cache_len)
+            bias = jnp.where(p_s >= 0, 0.0, -jnp.inf)          # [B, t, L]
+            keys = _cache_read(cfg, ck, sk)
+            vals = _cache_read(cfg, cv, sv)
+            qg = q.reshape(b, kv_heads, group, t, d_head)
+            s = jnp.einsum("bkgtd,bkld->bkgtl", qg, keys,
+                           preferred_element_type=jnp.float32)
+            s = s * d_head ** -0.5 + bias[:, None, None]
+            p = jax.nn.softmax(s, axis=-1)
+            out = jnp.einsum("bkgtl,bkld->bkgtd", p.astype(vals.dtype),
+                             vals, preferred_element_type=jnp.float32)
+            out = out.astype(cfg.dtype).transpose(0, 3, 1, 2, 4)
+            out = out.reshape(b, t, cfg.d_model)
+            return out_dense()(out)
 
         if cfg.decode_len > 0 and t != 1 and cfg.chunked_prefill:
             # CHUNKED PREFILL: continue a (possibly already-advanced) cache
@@ -1051,6 +1144,60 @@ def _paged_leaf_check(name: str) -> bool:
             "_BATCH_LED_CACHE_KEYS or _NON_BATCH_CACHE_KEYS so the "
             "page cache knows whether to page it")
     return True
+
+
+def cache_index_of(cache) -> jax.Array:
+    """The cache's position counter — the first ``cache_index`` leaf.
+    Every layer's counter advances in lockstep (each apply touches all
+    layers equally), so one leaf is the whole cache's position."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(cache)[0]:
+        if _cache_leaf_name(path) == "cache_index":
+            return leaf
+    raise ValueError("cache has no cache_index leaf")
+
+
+def cache_rollback(cache, new_index, active=None):
+    """Set every layer's ``cache_index`` to ``new_index`` — the
+    speculative-decode ROLLBACK: after a verify pass wrote k+1 candidate
+    positions, the accepted boundary is a per-row index assignment and
+    nothing else. Rejected-tail K/V stays in the cache as stale bytes;
+    the validity bias (``p_s >= 0``) derives visibility from the index,
+    so no clearing pass exists to forget. ``active`` (optional [S] bool)
+    preserves inactive rows' current per-leaf counters — a mid-prefill
+    slot's index must not be clobbered by its neighbors' verify tick."""
+    def leaf(path, x):
+        if _cache_leaf_name(path) != "cache_index":
+            return x
+        ni = jnp.broadcast_to(new_index, x.shape).astype(x.dtype)
+        return jnp.where(active, ni, x) if active is not None else ni
+
+    return jax.tree_util.tree_map_with_path(leaf, cache)
+
+
+def draft_truncate(cfg: GPTConfig, params, n_layers: int
+                   ) -> tuple[GPTConfig, dict]:
+    """An EARLY-EXIT draft from a trained checkpoint: the first
+    ``n_layers`` blocks of ``params`` (plus embed / final LN / head)
+    reused as the speculative draft model — a draft without a second
+    checkpoint. Proposal quality is what the truncated stack gives (the
+    usual early-exit trade); correctness never depends on it — the
+    verifier samples every delivered token. The returned tree SHARES the
+    kept leaves with ``params`` (no copy)."""
+    if not 1 <= n_layers < cfg.layers:
+        raise ValueError(
+            f"draft n_layers={n_layers} must be in [1, {cfg.layers}) — "
+            "a draft at full depth proposes at full cost")
+    if cfg.moe_every:
+        raise ValueError("draft_truncate does not support MoE configs "
+                         "(the decode stack has no MoE path)")
+    dcfg = dataclasses.replace(cfg, layers=n_layers)
+    keep = {"token_embed", "ln_f", "lm_head"} | {
+        f"layer_{i}" for i in range(n_layers)}
+    missing = keep - set(params)
+    if missing:
+        raise ValueError(f"params tree is missing {sorted(missing)} — "
+                         "not a GPT checkpoint?")
+    return dcfg, {k: params[k] for k in sorted(keep)}
 
 
 def cache_load_pages(cache, pool, slot, page_ids, n_valid):

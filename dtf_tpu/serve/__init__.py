@@ -7,8 +7,12 @@ from millions of users") actually asks for. Five layers:
 - :mod:`dtf_tpu.serve.engine` — ``DecodeEngine``: KV cache + per-slot
   positions/rng/sampling-params as persistent sharded device state, with
   exactly TWO AOT-compiled fixed-shape programs (``prefill_into_slot``,
-  ``decode_all``), plus an optional prefix page pool with two more
-  (``page_save``/``page_load``). Zero steady-state recompiles by
+  ``decode_all``) — or exactly FOUR with speculative decoding armed
+  (``prefill``, ``decode/verify``, ``draft_prefill``, ``draft_all``: a
+  small draft model proposes k tokens per slot per tick, the verifier
+  scores all k+1 positions in one masked pass, token streams identical
+  to plain decode) — plus an optional prefix page pool with its own
+  ``page_save``/``page_load`` pair. Zero steady-state recompiles by
   construction.
 - :mod:`dtf_tpu.serve.pages` — the block-granular prefix KV cache:
   fixed-size pages with refcounts and LRU eviction, keyed by token-hash
@@ -19,7 +23,12 @@ from millions of users") actually asks for. Five layers:
   SLO metrics.
 - :mod:`dtf_tpu.serve.router` — ``Router``: N engine replicas (one shared
   param tree, independent KV state) behind least-occupancy admission with
-  queue-depth tiebreak, ``router_wait`` spans and per-replica SLO rollups.
+  queue-depth tiebreak, ``router_wait`` spans and per-replica SLO
+  rollups. With ``prefill_replicas=N`` the fleet DISAGGREGATES: dedicated
+  prefill replicas absorb long-prompt work and hand the KV off through a
+  shared page store (``PageStore`` — the pool as transport) to decode
+  replicas, and admission routes by request phase instead of occupancy
+  alone.
 - :mod:`dtf_tpu.serve.client` — in-process submit/poll API plus a seeded
   Poisson load generator for benching.
 - :mod:`dtf_tpu.serve.health` — the resilience tier (ISSUE 12): a
@@ -38,12 +47,12 @@ from dtf_tpu.serve.client import (Heartbeat, PoissonLoadGen, ServeClient,
 from dtf_tpu.serve.engine import DecodeEngine, decode_step_view
 from dtf_tpu.serve.health import (HealthConfig, HealthTracker,
                                   install_serve_fault)
-from dtf_tpu.serve.pages import PrefixIndex
+from dtf_tpu.serve.pages import PageStore, PrefixIndex
 from dtf_tpu.serve.router import Router
 from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
                                      RequestFailed, Scheduler)
 
 __all__ = ["DecodeEngine", "FAILED_STATUSES", "Heartbeat", "HealthConfig",
-           "HealthTracker", "PoissonLoadGen", "PrefixIndex", "Request",
-           "RequestFailed", "Router", "Scheduler", "ServeClient",
+           "HealthTracker", "PageStore", "PoissonLoadGen", "PrefixIndex",
+           "Request", "RequestFailed", "Router", "Scheduler", "ServeClient",
            "decode_step_view", "install_serve_fault", "replay"]

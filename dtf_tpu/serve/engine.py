@@ -8,7 +8,13 @@ independent request at its own position (``GPTConfig.slot_decode`` — the
 ``cache_index`` variable is per-row), so requests stream in and out of rows
 while the shapes never change.
 
-Exactly two jitted programs exist, both AOT-compiled at construction:
+Without a draft model, exactly two jitted programs exist, both
+AOT-compiled at construction; with one (``draft_cfg``/``draft_params`` +
+``spec_k`` — speculative decoding), exactly FOUR, never more:
+``prefill``, ``decode/verify`` (ONE program — the (k+1)-wide verify step
+IS spec decode; there is no separate single-token program), and the
+draft twins ``draft_prefill`` / ``draft_all``. See the speculative
+section below.
 
 - ``prefill_into_slot(slot, chunk, ...)`` — one fixed-width prompt chunk
   into one slot. The slot's rows are sliced out of the engine state into a
@@ -35,6 +41,24 @@ are untouched, so
 ``trace_counts`` stays pinned at ``{prefill: 1, decode: 1}`` and the page
 programs carry their own ``page_trace_counts`` fence.
 
+**Speculative decoding** (``spec_k > 0``): each tick is ``draft_all``
+(the small draft model proposes k greedy tokens per active slot, one
+dispatch, its own slot cache) followed by ``decode/verify`` (the target
+scores all k+1 positions in one masked pass — the model's slot-verify
+branch — samples its OWN token per position through the row's rng
+stream, and accepts the longest proposal prefix matching those samples:
+``n_emit = 1 + |match|`` tokens per slot per tick, cache index rolled
+back to the accepted boundary per row, rejected-tail KV left masked by
+the validity bias). Token streams are IDENTICAL to non-speculative
+decode (greedy and seeded sampling alike — the verifier's samples are
+the stream; proposals only decide how many positions per dispatch are
+worth keeping), pinned by tests/test_serve_spec.py. The draft's cache
+stays in sync through host-mirrored ``(tok, index)`` operands that ride
+readbacks decode performs anyway; the draft never touches the page pool
+(its prefill always covers the full prompt). A draft failure falls back
+to verify-with-null-proposals — plain decode — instead of erroring
+requests.
+
 Because all programs are compiled executables, steady state CANNOT
 recompile — a shape change would be a loud call-site error, not a silent
 retrace (``trace_counts`` exposes the per-program trace counters the fence
@@ -59,6 +83,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import logging
 import math
 from typing import Any, Optional, Sequence
 
@@ -68,6 +93,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtf_tpu.models import gpt
+
+log = logging.getLogger("dtf_tpu")
 
 PyTree = Any
 
@@ -171,6 +198,113 @@ def _build_decode_fn(model: gpt.GPT):
         return new_state, {"token": nxt, "done": done}
 
     return decode_fn
+
+
+def _build_draft_fn(model: gpt.GPT, k: int):
+    """draft_all: k GREEDY proposals per active slot in ONE dispatch — an
+    unrolled loop of single-token ``slot_decode`` steps of the (small)
+    draft model, writing the draft's own KV cache as it goes. Greedy on
+    purpose: proposals are guesses the verifier prefix-matches against
+    its own sampled stream, so they carry no rng and no sampling params —
+    the draft's job is to be RIGHT often, not random. ``sync_index``
+    (host-tracked by the engine) first rolls every active row's draft
+    cache index to the verifier's accepted boundary, so rejected
+    proposals from the last tick are forgotten the same way the
+    verifier's are: by index assignment, never by clearing."""
+    def draft_fn(params, state, tok, sync_index):
+        active = state["active"]
+        cache = gpt.cache_rollback(state["cache"], sync_index, active=active)
+        cur = tok
+        props = []
+        # k+1 steps for k proposals: the LAST step ingests d_k itself
+        # (output discarded), so on a clean sweep — where the verifier
+        # advances k+1 positions (k matches + the bonus token) — the
+        # draft cache has no hole at position idx+k. Without it, every
+        # full acceptance would leave one permanently unwritten position
+        # behind the rolled-forward index, quietly poisoning all later
+        # proposals for that slot.
+        for _ in range(k + 1):
+            logits, mut = model.apply(
+                {"params": params, "cache": cache}, cur[:, None],
+                deterministic=True, mutable=["cache"], decode_active=active)
+            cache = mut["cache"]
+            cur = jnp.argmax(logits[:, 0], -1).astype(jnp.int32)
+            if len(props) < k:
+                props.append(cur)
+        return {**state, "cache": cache}, jnp.stack(props, axis=1)
+
+    return draft_fn
+
+
+def _build_verify_fn(model: gpt.GPT, k: int):
+    """decode/verify: ONE (k+1)-token masked step across all slots — the
+    speculative replacement for :func:`_build_decode_fn`'s single-token
+    program (a spec engine compiles this under the same ``decode`` trace
+    fence; there is no separate plain-decode program).
+
+    Inputs per row: the pending token plus the k draft proposals. The
+    model's slot-verify branch scores every position against the row's
+    own cache; the verifier then samples its OWN token at each position
+    through the row's rng stream — exactly one ``jax.random.split`` per
+    EMITTED token, the same chain sequential decode consumes, with the
+    same eos→pad freezing per position. Acceptance is a per-row PREFIX
+    MATCH of the proposals against those sampled tokens: ``n_emit = 1 +
+    |matching prefix|`` (position j+1's logits are only valid when
+    inputs 1..j matched the emitted stream, which the prefix rule
+    guarantees; the +1 is the verifier's own token — the correction on a
+    mismatch, the bonus on a clean sweep). The cache index rolls back to
+    the accepted boundary per row (:func:`gpt.cache_rollback`); rng/tok/
+    done select the ``n_emit``-th chain entry, so a spec engine's visible
+    state after a tick is what ``n_emit`` sequential decode steps would
+    have left. Correct for ARBITRARY proposals (worst case n_emit = 1,
+    i.e. plain decode) — the draft-failure fallback rides that."""
+    def verify_fn(params, state, proposals):
+        active = state["active"]
+        idx0 = gpt.cache_index_of(state["cache"])              # [S]
+        inputs = jnp.concatenate([state["tok"][:, None], proposals], axis=1)
+        logits, mut = model.apply(
+            {"params": params, "cache": state["cache"]}, inputs,
+            deterministic=True, mutable=["cache"], decode_active=active)
+
+        def one(key, lv, temp, tk, tp, eos, pad, done0):
+            # the row's rng/eos chain, unrolled k+1 deep: entry j is what
+            # the j-th sequential decode step would have sampled/split
+            toks, dones, keys = [], [], [key]
+            done, cur = done0, key
+            for j in range(k + 1):
+                s2 = jax.random.split(cur)
+                v = _pick(s2[1], lv[j], temp, tk, tp)
+                tkn = jnp.where(done, pad, v)
+                done = done | ((eos >= 0) & (tkn == eos))
+                toks.append(tkn)
+                dones.append(done)
+                keys.append(s2[0])
+                cur = s2[0]
+            return jnp.stack(toks), jnp.stack(dones), jnp.stack(keys)
+
+        toks, dones, keys = jax.vmap(one)(
+            state["rng"], logits, state["temp"], state["top_k"],
+            state["top_p"], state["eos"], state["pad"], state["done"])
+        match = jnp.cumprod((toks[:, :k] == proposals).astype(jnp.int32),
+                            axis=1)
+        n_emit = jnp.where(active, 1 + match.sum(axis=1),
+                           0)                                   # [S] 0..k+1
+        last = jnp.maximum(n_emit, 1) - 1
+        new_tok = jnp.take_along_axis(toks, last[:, None], axis=1)[:, 0]
+        new_done = jnp.take_along_axis(dones, last[:, None], axis=1)[:, 0]
+        new_rng = jnp.take_along_axis(keys, n_emit[:, None, None],
+                                      axis=1)[:, 0]
+        cache = gpt.cache_rollback(mut["cache"], idx0 + n_emit,
+                                   active=active)
+        new_state = {
+            **state, "cache": cache,
+            "rng": jnp.where(active[:, None], new_rng, state["rng"]),
+            "tok": jnp.where(active, new_tok, state["tok"]),
+            "done": jnp.where(active, new_done, state["done"]),
+        }
+        return new_state, {"tokens": toks, "done": dones, "n_emit": n_emit}
+
+    return verify_fn
 
 
 def _build_prefill_fn(model: gpt.GPT):
@@ -300,6 +434,13 @@ def _zeros_like_struct(struct: PyTree) -> PyTree:
     return jax.tree.map(leaf, struct)
 
 
+def _cfg_label(cfg: gpt.GPTConfig) -> str:
+    """A compact architecture identity for tune-cache keys — enough to
+    distinguish model/draft pairs without serializing the whole config."""
+    return (f"d{cfg.d_model}L{cfg.layers}h{cfg.heads}"
+            f"kv{cfg.kv_heads_resolved}v{cfg.vocab_size}")
+
+
 class DecodeEngine:
     """Slot-pooled online decode over a GPT checkpoint.
 
@@ -314,7 +455,10 @@ class DecodeEngine:
     def __init__(self, cfg: gpt.GPTConfig, params: PyTree, *, n_slots: int,
                  max_len: int, prefill_chunk: int = 16,
                  mesh: Optional[Mesh] = None, kv_page_size: int = 0,
-                 prefix_pages: int = 0, page_save_after: int = 2):
+                 prefix_pages: int = 0, page_save_after: int = 2,
+                 draft_cfg: Optional[gpt.GPTConfig] = None,
+                 draft_params: PyTree = None, spec_k: int = 0,
+                 shared_pages=None):
         if n_slots < 1:
             raise ValueError(f"n_slots={n_slots} must be >= 1")
         if max_len < 2:
@@ -363,12 +507,68 @@ class DecodeEngine:
         self.page_size = kv_page_size if prefix_pages else 0
         self.n_pages = prefix_pages
         self.mesh = mesh
+
+        # ---- speculative decoding (draft model + verify step) -------------
+        # spec_k == 0 with a draft present = "tuner decides" (the block-
+        # shape sentinel contract, dtf_tpu/tune): the banked per-(model,
+        # draft, slots) winner resolves the width; an explicit spec_k wins
+        # with a warn-once when it overrides a MEASURED winner.
+        if spec_k < 0:
+            raise ValueError(f"spec_k={spec_k} must be >= 0")
+        if spec_k and draft_cfg is None:
+            raise ValueError(
+                f"spec_k={spec_k} needs a draft model: pass draft_cfg + "
+                "draft_params (speculation verifies a second model's "
+                "proposals — there is nothing to verify without one)")
+        self.spec_k = 0
+        self.draft_cfg: Optional[gpt.GPTConfig] = None
+        if draft_cfg is not None:
+            if draft_params is None:
+                raise ValueError("draft_cfg without draft_params")
+            if base.attn_window or draft_cfg.attn_window:
+                raise ValueError(
+                    "speculative decoding needs the full windowless cache "
+                    "layout on BOTH models (rolled buffers cannot roll a "
+                    f"rejected tail back); got attn_window="
+                    f"{base.attn_window}/{draft_cfg.attn_window}")
+            if draft_cfg.vocab_size != base.vocab_size:
+                raise ValueError(
+                    f"draft vocab {draft_cfg.vocab_size} != target vocab "
+                    f"{base.vocab_size}: a draft must propose in the "
+                    "verifier's token space")
+            from dtf_tpu.tune import resolver as tune_resolver
+
+            plan = tune_resolver.spec_k_plan(
+                model=_cfg_label(base), draft=_cfg_label(draft_cfg),
+                n_slots=n_slots,
+                backend=jax.default_backend())
+            if spec_k == 0:
+                self.spec_k = plan.k
+            else:
+                self.spec_k = spec_k
+                tune_resolver.note_override(
+                    "spec_k", "k", spec_k, plan.k,
+                    source=plan.source, measured=plan.measured)
+            if self.spec_k + 1 >= max_len:
+                raise ValueError(
+                    f"spec_k={self.spec_k} leaves no room in the "
+                    f"max_len={max_len} cache for a verify window")
+
         #: host-side call counters (plain ints — zero device readbacks):
         #: the bench/telemetry surface for "how much prefill work ran".
         self.counters = {"prefill_chunks": 0, "decode_steps": 0,
                          "pages_loaded": 0, "pages_saved": 0,
                          "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
                          "probe_decodes": 0}
+        if self.spec_k:
+            # acceptance/fallback accounting: proposed counts k per LIVE
+            # verified row per tick, accepted counts the matched prefix
+            # (n_emit - 1); stale still-active rows ride both sides, so
+            # the scheduler's per-running-slot rollup is the exact one.
+            self.counters.update({"draft_steps": 0,
+                                  "draft_prefill_chunks": 0,
+                                  "draft_fallbacks": 0,
+                                  "spec_proposed": 0, "spec_accepted": 0})
         #: when True, each compiled-program dispatch is wrapped in a
         #: jax.profiler.TraceAnnotation carrying the request trace id(s) the
         #: scheduler threaded down — a ProfilerHook window over a serving
@@ -384,6 +584,9 @@ class DecodeEngine:
             # instead of re-lowering — commit params here once.
             dev = jax.devices()[0]
             params = jax.tree.map(lambda x: jax.device_put(x, dev), params)
+            if self.spec_k:
+                draft_params = jax.tree.map(
+                    lambda x: jax.device_put(x, dev), draft_params)
         self._params = params
         self._decode_model = gpt.GPT(
             dataclasses.replace(base, slot_decode=True), mesh)
@@ -405,8 +608,10 @@ class DecodeEngine:
         #: traces each exactly once; any later increment would mean a
         #: shape-driven retrace, which the compiled executables make
         #: impossible by construction (they reject new shapes instead).
+        #: With a draft model there are exactly FOUR programs — prefill,
+        #: decode/verify (ONE program: the verify step IS spec decode),
+        #: draft_prefill, draft — and the fence pins all four.
         self.trace_counts = {"prefill": 0, "decode": 0}
-        decode_fn = _build_decode_fn(self._decode_model)
         prefill_fn = _build_prefill_fn(self._prefill_model)
 
         def counted(name, fn):
@@ -415,20 +620,22 @@ class DecodeEngine:
                 return fn(*args)
             return wrapped
 
-        abs_params = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype,
-                sharding=x.sharding if mesh is not None else None),
-            params)
-        abs_state = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype,
-                sharding=x.sharding if mesh is not None else None),
-            self._state)
+        def abs_of(tree):
+            return jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=x.sharding if mesh is not None else None),
+                tree)
+
+        abs_params = abs_of(params)
+        abs_state = abs_of(self._state)
         s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
         s_f32 = jax.ShapeDtypeStruct((), jnp.float32)
         s_bool = jax.ShapeDtypeStruct((), jnp.bool_)
-        jit_kw = {}
+        chunk_abs = jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32)
+        key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        jit_kw, verify_kw = {}, {}
+        rep = None
         if mesh is not None:
             # pin the OUTPUT state to the input layout: GSPMD would
             # otherwise pick its own output shardings, and the next call
@@ -437,29 +644,119 @@ class DecodeEngine:
             state_sh = jax.tree.map(lambda s: s.sharding, abs_state)
             jit_kw["out_shardings"] = (state_sh,
                                        {"token": rep, "done": rep})
-        self._decode_c = jax.jit(counted("decode", decode_fn),
-                                 **jit_kw).lower(
-            abs_params, abs_state).compile()
+            verify_kw["out_shardings"] = (state_sh,
+                                          {"tokens": rep, "done": rep,
+                                           "n_emit": rep})
+        if self.spec_k:
+            verify_fn = _build_verify_fn(self._decode_model, self.spec_k)
+            props_abs = jax.ShapeDtypeStruct((n_slots, self.spec_k),
+                                             jnp.int32, sharding=rep)
+            self._decode_c = jax.jit(counted("decode", verify_fn),
+                                     **verify_kw).lower(
+                abs_params, abs_state, props_abs).compile()
+        else:
+            decode_fn = _build_decode_fn(self._decode_model)
+            self._decode_c = jax.jit(counted("decode", decode_fn),
+                                     **jit_kw).lower(
+                abs_params, abs_state).compile()
         self._prefill_c = jax.jit(counted("prefill", prefill_fn),
                                   **jit_kw).lower(
-            abs_params, abs_state, s_i32, s_i32,
-            jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32), s_i32,
+            abs_params, abs_state, s_i32, s_i32, chunk_abs, s_i32,
             s_bool, s_bool, s_f32, s_i32, s_f32, s_i32, s_i32,
-            jax.ShapeDtypeStruct((2,), jnp.uint32)).compile()
+            key_abs).compile()
+
+        if self.spec_k:
+            self.trace_counts.update({"draft_prefill": 0, "draft": 0})
+            dbase = dataclasses.replace(
+                draft_cfg, decode_len=max_len, slot_decode=False,
+                chunked_prefill=False)
+            self.draft_cfg = dbase
+            self._draft_params = draft_params
+            self._draft_decode_model = gpt.GPT(
+                dataclasses.replace(dbase, slot_decode=True), mesh)
+            self._draft_prefill_model = gpt.GPT(
+                dataclasses.replace(dbase, chunked_prefill=True), mesh)
+            dstruct = _state_struct(
+                dataclasses.replace(dbase, slot_decode=True), n_slots, mesh)
+            self._draft_state = _zeros_like_struct(dstruct)
+            abs_dparams = abs_of(draft_params)
+            abs_dstate = abs_of(self._draft_state)
+            dp_kw, da_kw = {}, {}
+            if mesh is not None:
+                dstate_sh = jax.tree.map(lambda s: s.sharding, abs_dstate)
+                dp_kw["out_shardings"] = (dstate_sh,
+                                          {"token": rep, "done": rep})
+                da_kw["out_shardings"] = (dstate_sh, rep)
+            self._draft_prefill_c = jax.jit(
+                counted("draft_prefill",
+                        _build_prefill_fn(self._draft_prefill_model)),
+                **dp_kw).lower(
+                abs_dparams, abs_dstate, s_i32, s_i32, chunk_abs, s_i32,
+                s_bool, s_bool, s_f32, s_i32, s_f32, s_i32, s_i32,
+                key_abs).compile()
+            self._draft_c = jax.jit(
+                counted("draft",
+                        _build_draft_fn(self._draft_decode_model,
+                                        self.spec_k)),
+                **da_kw).lower(
+                abs_dparams, abs_dstate,
+                jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=rep),
+                jax.ShapeDtypeStruct((n_slots,), jnp.int32,
+                                     sharding=rep)).compile()
+            #: host mirrors of the verifier's per-slot position and
+            #: pending token (fed to draft_all as sync operands): updated
+            #: from values decode() reads back ANYWAY (tokens/n_emit), so
+            #: speculation adds zero extra device readbacks per tick.
+            self._spec_tok = np.zeros((n_slots,), np.int32)
+            self._spec_index = np.zeros((n_slots,), np.int32)
+            self._draft_chunks = np.zeros((n_slots,), np.int32)
+            #: SELF-speculation (draft ≡ target architecture): the draft
+            #: cache is struct-identical to the target's, so the page
+            #: programs accept it and a prefix-page hit shortcuts the
+            #: DRAFT prefill too (same weights ⇒ the pooled KV is the
+            #: draft's KV). With a distinct draft model the pool holds
+            #: foreign KV and the draft always prefills the full prompt.
+            self._draft_self = dbase == base
+            self._draft_start = np.zeros((n_slots,), np.int32)
+            self._draft_pending = np.zeros((n_slots,), np.int32)
+            if self._draft_self:
+                self.counters["draft_pages_loaded"] = 0
 
         #: the prefix page cache (None unless prefix_pages > 0): device
         #: pool + host index + two more AOT programs with their own trace
         #: fence — trace_counts itself stays pinned at {prefill, decode}.
-        self._prefix: Optional["pages_lib.PrefixIndex"] = None
+        #: ``shared_pages`` mounts another engine's :class:`PageStore`
+        #: instead of allocating — the disaggregation KV transport: pages a
+        #: prefill replica saves are immediately loadable by every decode
+        #: replica mounting the same store.
+        self._page_store = None
         self.page_trace_counts = {}
+        if shared_pages is not None and not prefix_pages:
+            raise ValueError(
+                "shared_pages needs prefix_pages > 0 on the mounting "
+                "engine too (the pool shapes come from its own config)")
         if prefix_pages:
             from dtf_tpu.serve import pages as pages_lib
 
             pool_abs = pages_lib.pool_abstract(
                 abs_state["cache"], prefix_pages, kv_page_size, mesh)
-            self._pages = _zeros_like_struct(pool_abs)
-            self._prefix = pages_lib.PrefixIndex(
-                prefix_pages, kv_page_size, save_after=page_save_after)
+            if shared_pages is not None:
+                pages_lib.check_pool_compatible(shared_pages.pool, pool_abs)
+                if (shared_pages.index.n_pages != prefix_pages
+                        or shared_pages.index.page_size != kv_page_size):
+                    raise ValueError(
+                        f"shared page store is {shared_pages.index.n_pages}"
+                        f"x{shared_pages.index.page_size}-token pages; "
+                        f"this engine asked for {prefix_pages}"
+                        f"x{kv_page_size}")
+                self._page_store = shared_pages
+                self._owns_pages = False
+            else:
+                self._page_store = pages_lib.PageStore(
+                    _zeros_like_struct(pool_abs),
+                    pages_lib.PrefixIndex(prefix_pages, kv_page_size,
+                                          save_after=page_save_after))
+                self._owns_pages = True
             self.page_trace_counts = {"save": 0, "load": 0}
 
             def pcounted(name, fn):
@@ -486,6 +783,25 @@ class DecodeEngine:
                 abs_state, pool_abs, s_i32, ids_abs, s_i32).compile()
 
     # ------------------------------------------------------------- host API
+
+    @property
+    def page_store(self):
+        """The engine's mountable prefix-page state (None with the cache
+        off) — pass as ``shared_pages=`` to further engines to share one
+        pool+index (the disaggregation KV transport)."""
+        return self._page_store
+
+    @property
+    def _prefix(self):
+        return None if self._page_store is None else self._page_store.index
+
+    @property
+    def _pages(self):
+        return self._page_store.pool
+
+    @_pages.setter
+    def _pages(self, pool):
+        self._page_store.pool = pool
 
     def n_chunks(self, prompt_len: int) -> int:
         return math.ceil(prompt_len / self.prefill_chunk)
@@ -547,9 +863,60 @@ class DecodeEngine:
                 np.int32(pad_id),
                 np.asarray(jax.random.PRNGKey(seed), np.uint32))
         self.counters["prefill_chunks"] += 1
+        if self.spec_k:
+            # the DRAFT cache must ingest the same prompt (pages never
+            # shortcut it — the draft pool does not exist, and the draft
+            # is cheap enough that full-prompt draft prefill still wins):
+            # one draft chunk rides along per target chunk, and the tail
+            # (page-hit admissions cover fewer live target chunks than
+            # the draft's full count) completes with the LAST target
+            # chunk, so both models flip active in the same host call.
+            if chunk_i == 0:
+                self._draft_chunks[slot] = 0
+                # a page load just before this admission shortcuts the
+                # draft too (self-spec; load_prefix staged the count)
+                self._draft_start[slot] = self._draft_pending[slot]
+                self._draft_pending[slot] = 0
+            dstart = int(self._draft_start[slot])
+            n_d = self.n_chunks(len(prompt) - dstart)
+            if self._draft_chunks[slot] < n_d:
+                self._draft_prefill_chunk(slot, prompt,
+                                          int(self._draft_chunks[slot]),
+                                          dstart)
+            if last:
+                while self._draft_chunks[slot] < n_d:
+                    self._draft_prefill_chunk(
+                        slot, prompt, int(self._draft_chunks[slot]),
+                        dstart)
         if not last:
             return None
+        if self.spec_k:
+            self._spec_index[slot] = len(prompt)
+            self._spec_tok[slot] = int(out["token"])
         return int(out["token"]), bool(out["done"])
+
+    def _draft_prefill_chunk(self, slot: int, prompt: Sequence[int],
+                             chunk_i: int, start: int = 0) -> None:
+        """One fixed-width chunk of the DRAFT model's prefill into
+        ``slot`` — the draft_prefill program, covering ``prompt[start:]``
+        (``start`` > 0 only under self-speculation, where a page hit
+        already landed the stem in the draft cache). The sampled first
+        token is discarded: the request's sampling stream belongs to the
+        verifier alone."""
+        c = self.prefill_chunk
+        tail = list(int(t) for t in prompt)[start:]
+        n_d = self.n_chunks(len(tail))
+        seg = tail[chunk_i * c:(chunk_i + 1) * c]
+        buf = np.zeros((c,), np.int32)
+        buf[:len(seg)] = seg
+        self._draft_state, _ = self._draft_prefill_c(
+            self._draft_params, self._draft_state, np.int32(slot),
+            np.int32(start), buf, np.int32(len(seg)),
+            np.bool_(chunk_i == 0), np.bool_(chunk_i == n_d - 1),
+            np.float32(0.0), np.int32(0), np.float32(1.0), np.int32(-1),
+            np.int32(0), np.asarray(jax.random.PRNGKey(0), np.uint32))
+        self.counters["draft_prefill_chunks"] += 1
+        self._draft_chunks[slot] += 1
 
     def prefill(self, slot: int, prompt: Sequence[int], *, start: int = 0,
                 **sampling) -> tuple[int, bool]:
@@ -568,13 +935,21 @@ class DecodeEngine:
                                           **sampling)
         return out
 
-    def decode(self, *, trace_ids: Optional[Sequence[int]] = None
-               ) -> tuple[np.ndarray, np.ndarray]:
-        """One masked token step across all slots. Returns
-        ``(tokens [n_slots], done [n_slots])`` as host arrays — the one
-        device→host sync per generated token (EOS and delivery decisions
-        live on the host). ``trace_ids`` (scheduler-threaded) names the
-        requests this step serves in the XPlane annotation."""
+    def decode(self, *, trace_ids: Optional[Sequence[int]] = None):
+        """One masked token step across all slots.
+
+        Without a draft model: ``(tokens [n_slots], done [n_slots])`` as
+        host arrays — the one device→host sync per generated token (EOS
+        and delivery decisions live on the host). With ``spec_k > 0`` the
+        step is SPECULATIVE — draft_all proposes k tokens per slot, the
+        verify program scores all k+1 positions in one pass — and the
+        return is ``(tokens [n_slots, k+1], done [n_slots, k+1],
+        n_emit [n_slots])``: the scheduler delivers ``tokens[s, :n_emit
+        [s]]`` per slot (still one sync per TICK, now worth up to k+1
+        tokens). ``trace_ids`` (scheduler-threaded) names the requests
+        this step serves in the XPlane annotation."""
+        if self.spec_k:
+            return self._decode_spec(trace_ids)
         with self._annotation(
                 "dtf.serve.decode",
                 trace_ids="" if trace_ids is None
@@ -582,6 +957,49 @@ class DecodeEngine:
             self._state, out = self._decode_c(self._params, self._state)
         self.counters["decode_steps"] += 1
         return np.asarray(out["token"]), np.asarray(out["done"])
+
+    def draft_propose(self):
+        """One draft_all dispatch: k greedy proposals per slot off the
+        draft model's own cache (rolled to the verifier's accepted
+        boundary via the host-mirrored sync index first). Split out of
+        :meth:`decode` so chaos injectors can wrap it — a poisoned draft
+        must fall back to plain decode, not error the request."""
+        self._draft_state, props = self._draft_c(
+            self._draft_params, self._draft_state, self._spec_tok,
+            self._spec_index)
+        self.counters["draft_steps"] += 1
+        return props
+
+    def _decode_spec(self, trace_ids):
+        try:
+            props = self.draft_propose()
+        except Exception as e:  # noqa: BLE001 — a draft failure must not
+            # fail requests: the verify step is CORRECT for arbitrary
+            # proposals (worst case it emits 1 token — plain decode), so
+            # null proposals are the fallback, not an error.
+            log.warning("draft_all failed (%r); falling back to plain "
+                        "decode this tick", e)
+            self.counters["draft_fallbacks"] += 1
+            props = np.zeros((self.n_slots, self.spec_k), np.int32)
+        with self._annotation(
+                "dtf.serve.decode",
+                trace_ids="" if trace_ids is None
+                else ",".join(map(str, trace_ids))):
+            self._state, out = self._decode_c(self._params, self._state,
+                                              props)
+        self.counters["decode_steps"] += 1
+        toks = np.asarray(out["tokens"])
+        dones = np.asarray(out["done"])
+        n_emit = np.asarray(out["n_emit"]).astype(np.int32)
+        # host mirrors advance from values this readback carries anyway
+        live = n_emit > 0
+        self._spec_index = self._spec_index + n_emit
+        picked = toks[np.arange(self.n_slots), np.maximum(n_emit, 1) - 1]
+        self._spec_tok = np.where(live, picked,
+                                  self._spec_tok).astype(np.int32)
+        self.counters["spec_proposed"] += int(self.spec_k * live.sum())
+        self.counters["spec_accepted"] += int((n_emit[live] - 1).sum())
+        return toks, dones, n_emit
 
     def probe(self) -> None:
         """One decode dispatch with the outputs discarded — the Router's
@@ -633,6 +1051,16 @@ class DecodeEngine:
             self._state, self._pages, np.int32(slot), self._ids_buf(ids),
             np.int32(len(ids)))
         self.counters["pages_loaded"] += len(ids)
+        if self.spec_k and self._draft_self:
+            # self-speculation: the draft cache is struct-identical, so
+            # the SAME compiled gather lands the chain there too — the
+            # draft's prefill then covers only the uncached tail, like
+            # the target's (no draft page programs exist or are needed)
+            self._draft_state = self._page_load_c(
+                self._draft_state, self._pages, np.int32(slot),
+                self._ids_buf(ids), np.int32(len(ids)))
+            self._draft_pending[slot] = handle.n_tokens
+            self.counters["draft_pages_loaded"] += len(ids)
 
     def save_prefix_pages(self, slot: int, prompt: Sequence[int]) -> None:
         """After a request's LAST prefill chunk: register every full page
@@ -700,10 +1128,15 @@ class DecodeEngine:
                 "pinned": self._prefix.pinned()}
 
     def cache_bytes(self) -> int:
-        """Resident KV footprint: slot cache + page pool, all layers."""
+        """Resident KV footprint: slot cache + page pool (a MOUNTED shared
+        pool counts on its owning engine only — summing a fleet must not
+        multiply one pool by the replica count), all layers; with a draft
+        model, its slot cache too."""
         leaves = jax.tree.leaves(self._state["cache"])
-        if self._prefix is not None:
+        if self._prefix is not None and self._owns_pages:
             leaves += jax.tree.leaves(self._pages)
+        if self.spec_k:
+            leaves += jax.tree.leaves(self._draft_state["cache"])
         return sum(int(np.prod(x.shape)) * x.dtype.itemsize
                    for x in leaves)
 
@@ -848,3 +1281,108 @@ def page_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
                                        jnp.int32),
            "n_valid": s_i32, "lo": s_i32, "hi": s_i32}
     return jax.jit(step, **jit_kw), {"state": state_abs, "pool": pool_abs}, ops
+
+
+def spec_step_view(cfg: gpt.GPTConfig, draft_cfg: gpt.GPTConfig, *,
+                   n_slots: int, max_len: int, spec_k: int,
+                   mesh: Optional[Mesh] = None):
+    """The SPECULATIVE tick (``draft_all`` ∘ ``verify``) as one
+    analyzable step — the two extra graphs a spec engine compiles, fenced
+    together the way ``page_step_view`` fences an admission tick. The
+    comms budget pins both the draft's unrolled k-step loop and the
+    (k+1)-wide verify pass (its TP all-reduces, the per-row cache
+    scatter, the rollback assignment); the memory fence prices the
+    k-token verify temp and the draft's resident cache — the numbers
+    ``analysis fit`` needs to answer "max slots with spec on"."""
+    dec_cfg = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True)
+    dr_base = dataclasses.replace(draft_cfg, decode_len=max_len)
+    dr_cfg = dataclasses.replace(dr_base, slot_decode=True)
+    verify_fn = _build_verify_fn(gpt.GPT(dec_cfg, mesh), spec_k)
+    draft_fn = _build_draft_fn(gpt.GPT(dr_cfg, mesh), spec_k)
+
+    def step(bundle, ops):
+        dstate, props = draft_fn(bundle["draft_params"],
+                                 bundle["draft_state"],
+                                 ops["tok"], ops["sync_index"])
+        state, out = verify_fn(bundle["params"], bundle["state"], props)
+        return {"state": state, "draft_state": dstate, "out": out}
+
+    abs_state = _state_struct(dec_cfg, n_slots, mesh)
+    abs_dstate = _state_struct(dr_cfg, n_slots, mesh)
+    bundle = {"params": _abs_params(dec_cfg, mesh),
+              "draft_params": _abs_params(dr_base, mesh),
+              "state": abs_state, "draft_state": abs_dstate}
+    vec = jax.ShapeDtypeStruct((n_slots,), jnp.int32)
+    ops = {"tok": vec, "sync_index": vec}
+    jit_kw = {}
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        jit_kw["out_shardings"] = {
+            "state": jax.tree.map(lambda s: s.sharding, abs_state),
+            "draft_state": jax.tree.map(lambda s: s.sharding, abs_dstate),
+            "out": {"tokens": rep, "done": rep, "n_emit": rep}}
+    return jax.jit(step, **jit_kw), bundle, ops
+
+
+def disagg_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
+                     prefill_chunk: int, kv_page_size: int, n_pages: int,
+                     mesh: Optional[Mesh] = None):
+    """The PREFILL-replica admission tick of a disaggregated fleet
+    (``prefill_into_slot`` ∘ ``page_save``): the handoff-producing
+    composition — a dedicated prefill replica's whole job is to run
+    prompt chunks and scatter the resulting KV pages into the shared
+    pool for decode replicas to gather. Fencing the composition pins the
+    transport's collective structure (the TP projections of the chunk
+    plus the pool scatter over data shards) so a layout change that
+    turns the handoff into whole-leaf traffic fails tier-1 first."""
+    if max_len % kv_page_size:
+        raise ValueError(
+            f"kv_page_size={kv_page_size} does not divide "
+            f"max_len={max_len} (same rule as DecodeEngine)")
+    base = dataclasses.replace(cfg, decode_len=max_len, slot_decode=False,
+                               chunked_prefill=False)
+    prefill_fn = _build_prefill_fn(
+        gpt.GPT(dataclasses.replace(base, chunked_prefill=True), mesh))
+    save_fn = _build_page_save_fn(n_pages)
+    state_abs = _state_struct(
+        dataclasses.replace(base, slot_decode=True), n_slots, mesh)
+    from dtf_tpu.serve import pages as pages_lib
+
+    pool_abs = pages_lib.pool_abstract(state_abs["cache"], n_pages,
+                                       kv_page_size, mesh)
+
+    def step(bundle, ops):
+        state, out = prefill_fn(
+            bundle["params"], bundle["state"], ops["slot"], ops["start"],
+            ops["chunk"], ops["n_valid"], ops["reset"], ops["is_last"],
+            ops["temp"], ops["top_k"], ops["top_p"], ops["eos"],
+            ops["pad"], ops["key"])
+        pool = save_fn(state, bundle["pool"], ops["slot"], ops["ids"],
+                       ops["lo"], ops["hi"])
+        return {"state": state, "pool": pool, "out": out}
+
+    jit_kw = {}
+    if mesh is not None:
+        rep = NamedSharding(mesh, P())
+        jit_kw["out_shardings"] = {
+            "state": jax.tree.map(lambda s: s.sharding, state_abs),
+            "pool": jax.tree.map(lambda s: s.sharding, pool_abs),
+            "out": {"token": rep, "done": rep}}
+    s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    ops = {
+        "slot": s_i32, "start": s_i32,
+        "chunk": jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32),
+        "n_valid": s_i32,
+        "reset": jax.ShapeDtypeStruct((), jnp.bool_),
+        "is_last": jax.ShapeDtypeStruct((), jnp.bool_),
+        "temp": jax.ShapeDtypeStruct((), jnp.float32),
+        "top_k": s_i32,
+        "top_p": jax.ShapeDtypeStruct((), jnp.float32),
+        "eos": s_i32, "pad": s_i32,
+        "key": jax.ShapeDtypeStruct((2,), jnp.uint32),
+        "ids": jax.ShapeDtypeStruct((max_len // kv_page_size,), jnp.int32),
+        "lo": s_i32, "hi": s_i32,
+    }
+    bundle = {"params": _abs_params(base, mesh), "state": state_abs,
+              "pool": pool_abs}
+    return jax.jit(step, **jit_kw), bundle, ops

@@ -293,6 +293,12 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
       prefill chunk of that request raises :class:`InjectedPoison`
       wherever it lands, even after a requeue. The scheduler must isolate
       it (terminal ``error`` status) without taking the replica down.
+    - ``poison_draft@N`` — the N-th submit is marked; while that request
+      is RUNNING on a replica, the replica's ``draft_propose`` raises
+      :class:`InjectedPoison`. The engine must fall back to plain decode
+      (verify with null proposals — ``draft_fallbacks`` counts) instead
+      of erroring the request or the replica: speculation is an
+      optimization, never a correctness dependency.
 
     Ticks are counted in the TARGET's own call domain (decode calls /
     submits) so plans stay deterministic under Poisson timing. ``sleep``
@@ -340,6 +346,41 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
                 return _orig(slot, prompt, chunk_i, **kw)
 
             eng.prefill_chunk_into = prefill
+        return state
+
+    if plan.kind == "poison_draft":
+        orig_submit = pump.submit
+        count = [0]
+
+        def submit(req, **kw):
+            if count[0] == plan.tick and state.poison_prompt is None:
+                state.poison_prompt = tuple(int(t) for t in req.prompt)
+                note("poison_armed", submit_index=count[0])
+            count[0] += 1
+            return orig_submit(req, **kw)
+
+        pump.submit = submit
+        for s in scheds:
+            eng = s.engine
+            if not getattr(eng, "spec_k", 0):
+                continue            # non-speculative engine (fakes, or a
+                                    # disagg prefill replica): no draft
+                                    # runs there — the plan no-ops
+            orig = eng.draft_propose
+
+            def draft(*, _orig=orig, _s=s, **kw):
+                if state.poison_prompt is not None and any(
+                        tuple(int(t) for t in r.req.prompt)
+                        == state.poison_prompt
+                        for r in _s._running.values()):
+                    if not state.fired:
+                        state.fired = True
+                        note("firing")
+                    raise InjectedPoison(
+                        f"injected draft poison (submit #{plan.tick})")
+                return _orig(**kw)
+
+            eng.draft_propose = draft
         return state
 
     delay = (wedge_s if wedge_s is not None

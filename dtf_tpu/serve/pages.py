@@ -94,6 +94,48 @@ def pool_abstract(cache_struct: PyTree, n_pages: int, page_size: int,
     return out
 
 
+class PageStore:
+    """Mountable prefix-page state: ONE device pool + ONE host
+    :class:`PrefixIndex` that several engines may share.
+
+    PR 6 gave every replica its own pool; prefill/decode disaggregation
+    (docs/SERVING.md) needs the pool as a **KV transport** — a dedicated
+    prefill replica saves pages that a decode replica then loads in its
+    one-gather admission — which is exactly "the same store mounted by N
+    engines". Updates are functional (each page program returns a fresh
+    pool tree that replaces :attr:`pool`) and the pump loop is
+    single-threaded, so a plain holder is the whole mechanism; on a
+    multi-host fleet this object is the seam where a cross-host page DMA
+    would slot in. Build one with :meth:`DecodeEngine's <build>` shapes
+    via ``Router.build(prefill_replicas=...)`` or mount an engine's own
+    (``engine.page_store``) into further engines (``shared_pages=``)."""
+
+    def __init__(self, pool, index: "PrefixIndex"):
+        self.pool = pool
+        self.index = index
+
+
+def check_pool_compatible(pool, pool_abs) -> None:
+    """A shared pool must be byte-compatible with what the mounting
+    engine would have allocated (same tree, shapes, dtypes) — a silent
+    mismatch would gather wrong-shaped KV into a live slot."""
+    import numpy as np
+
+    got = jax.tree_util.tree_flatten_with_path(pool)[0]
+    want = jax.tree_util.tree_flatten_with_path(pool_abs)[0]
+    if len(got) != len(want):
+        raise ValueError(
+            f"shared page pool has {len(got)} leaves, engine expects "
+            f"{len(want)} — different cache layout (kv dtype / GQA?)")
+    for (gp, g), (wp, w) in zip(got, want):
+        if gp != wp or tuple(g.shape) != tuple(w.shape) \
+                or np.dtype(g.dtype) != np.dtype(w.dtype):
+            raise ValueError(
+                f"shared page pool leaf {gp} is {g.shape}/{g.dtype}, "
+                f"engine expects {wp} {w.shape}/{w.dtype} — the engines "
+                "mounting one store must be built identically")
+
+
 @dataclasses.dataclass
 class _Entry:
     """One cached page: ``tokens`` is the WHOLE prefix through this page

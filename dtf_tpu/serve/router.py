@@ -42,6 +42,7 @@ and the chaos matrix that pins the behavior.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Optional, Sequence
 
@@ -69,9 +70,34 @@ class Router:
 
     def __init__(self, engines: Sequence[DecodeEngine], writer=None, *,
                  telemetry=None, ttft_slo_s: float = 0.0,
-                 clock=time.monotonic, health=None, **scheduler_kw):
+                 clock=time.monotonic, health=None,
+                 prefill_replicas: int = 0, **scheduler_kw):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
+        # prefill/decode DISAGGREGATION: the FIRST ``prefill_replicas``
+        # engines are dedicated prefill replicas — requests whose prompt
+        # has >= 1 uncached full page route there first, their KV pages
+        # land in the SHARED page store (the transport), and the request
+        # is then handed off to a decode replica whose admission gathers
+        # the pinned chain instead of re-running the transformer. A burst
+        # of long prompts therefore saturates prefill replicas, not the
+        # fleet's decode ticks.
+        self._prefill_replicas = prefill_replicas
+        if prefill_replicas:
+            if not 0 < prefill_replicas < len(engines):
+                raise ValueError(
+                    f"prefill_replicas={prefill_replicas} must leave at "
+                    f"least one decode replica (have {len(engines)})")
+            stores = {id(getattr(e, "page_store", None)) for e in engines}
+            if any(getattr(e, "page_store", None) is None
+                   for e in engines) or len(stores) != 1:
+                raise ValueError(
+                    "prefill/decode disaggregation needs every replica "
+                    "to mount ONE shared page store (the KV transport) — "
+                    "build via Router.build(prefill_replicas=..., "
+                    "prefix_pages=...)")
+        self._roles = ["prefill" if i < prefill_replicas else "decode"
+                       for i in range(len(engines))]
         self.telemetry = telemetry
         self.clock = clock
         self.schedulers = [
@@ -108,46 +134,104 @@ class Router:
         self._shed_cap = int(scheduler_kw.get("completed_cap", 100_000))
         self._shed_router = 0
         self._requeued = 0
+        #: in-flight prefill-phase handoffs: fleet rid -> (the ORIGINAL
+        #: request, its submit moment). While present, the rid points at
+        #: a max_new=1 prefill JOB on a prefill replica; on the job's
+        #: terminal status the original request is submitted to a decode
+        #: replica with the original submit_t (TTFT and deadlines honest
+        #: across the handoff) and hits the pages the job just saved.
+        self._handoff: dict[int, tuple[Request, float]] = {}
+        self._handoffs = 0
         self._next_id = 0
 
     @classmethod
     def build(cls, cfg, params, *, n_replicas: int, n_slots: int,
               max_len: int, prefill_chunk: int = 16, mesh=None,
               kv_page_size: int = 0, prefix_pages: int = 0,
-              page_save_after: int = 2, **router_kw) -> "Router":
-        """N identical replicas over ONE param tree. Each replica gets its
-        own KV state (and page pool, when enabled) and its own pair of AOT
-        programs; the params device arrays are shared."""
+              page_save_after: int = 2, draft_cfg=None, draft_params=None,
+              spec_k: int = 0, prefill_replicas: int = 0,
+              **router_kw) -> "Router":
+        """N replicas over ONE param tree. Each replica gets its own KV
+        state (and page pool, when enabled) and its own AOT programs; the
+        params device arrays are shared. ``draft_cfg``/``draft_params``/
+        ``spec_k`` arm speculative decoding on the DECODE replicas (a
+        dedicated prefill replica never decodes, so it skips the draft
+        programs). ``prefill_replicas=N`` disaggregates: the first N
+        replicas are prefill-role, ALL replicas mount one shared page
+        store (the KV transport; saves become eager — ``save_after`` is
+        forced to 1, a transport that waits for a second sighting would
+        hand off nothing), and the router routes by request phase."""
         if n_replicas < 1:
             raise ValueError(f"n_replicas={n_replicas} must be >= 1")
-        engines = [DecodeEngine(cfg, params, n_slots=n_slots,
-                                max_len=max_len,
-                                prefill_chunk=prefill_chunk, mesh=mesh,
-                                kv_page_size=kv_page_size,
-                                prefix_pages=prefix_pages,
-                                page_save_after=page_save_after)
-                   for _ in range(n_replicas)]
-        return cls(engines, **router_kw)
+        if prefill_replicas and not prefix_pages:
+            raise ValueError(
+                "prefill_replicas needs prefix_pages > 0: the page pool "
+                "IS the prefill→decode KV transport")
+        if prefill_replicas and not 0 < prefill_replicas < n_replicas:
+            # fail BEFORE compiling N engines (the ctor re-checks)
+            raise ValueError(
+                f"prefill_replicas={prefill_replicas} must leave at "
+                f"least one decode replica (have {n_replicas})")
+        if prefill_replicas:
+            page_save_after = 1
+        engines, store = [], None
+        for r in range(n_replicas):
+            pre = r < prefill_replicas
+            engines.append(DecodeEngine(
+                cfg, params, n_slots=n_slots, max_len=max_len,
+                prefill_chunk=prefill_chunk, mesh=mesh,
+                kv_page_size=kv_page_size, prefix_pages=prefix_pages,
+                page_save_after=page_save_after, shared_pages=store,
+                draft_cfg=None if pre else draft_cfg,
+                draft_params=None if pre else draft_params,
+                spec_k=0 if pre else spec_k))
+            if prefill_replicas and store is None:
+                store = engines[0].page_store
+        return cls(engines, prefill_replicas=prefill_replicas, **router_kw)
 
     # ------------------------------------------------------------ admission
 
     def _routable(self, i: int) -> bool:
         return self.health is None or self.health.routable(i)
 
-    def _pick(self) -> Optional[int]:
+    def _pick(self, phase: str = "decode") -> Optional[int]:
         """Least occupancy over ROUTABLE replicas (health rank first:
         healthy before degraded before probation); queue depth breaks the
         tie (every replica saturated → the shortest line), replica index
-        breaks that (deterministic tests). None when the whole fleet is
-        quarantined — the caller sheds at the front door."""
+        breaks that (deterministic tests). With disaggregation on, only
+        replicas of the request's PHASE role are candidates — unless that
+        role has no routable member, in which case the whole routable
+        fleet serves it (a quarantined prefill tier degrades to full
+        prefill on decode replicas; it never stops the fleet). None when
+        nothing at all is routable — the caller sheds at the front
+        door."""
         cands = [i for i in range(len(self.schedulers)) if self._routable(i)]
         if not cands:
             return None
+        if self._prefill_replicas:
+            role = [i for i in cands if self._roles[i] == phase]
+            cands = role or cands
         rank = (self.health.rank if self.health is not None
                 else (lambda i: 0))
         return min(cands,
                    key=lambda i: (rank(i), self.schedulers[i].occupancy,
                                   self.schedulers[i].queue_depth, i))
+
+    def _wants_prefill_replica(self, req: Request) -> bool:
+        """Phase classification: a request is PREFILL-HEAVY when at least
+        one full page of its prompt is not already in the shared store —
+        the work a dedicated prefill replica exists to absorb. Cached
+        stems and sub-page prompts go straight to decode replicas (their
+        admission is one page gather + a tail chunk)."""
+        if not self._prefill_replicas:
+            return False
+        eng = self.schedulers[0].engine
+        prompt = tuple(int(t) for t in req.prompt)
+        full = max(0, (len(prompt) - 1) // eng.page_size)
+        if full < 1:
+            return False
+        have, _ = eng._prefix.longest(prompt, cap=full)
+        return have < full
 
     def _shed_at_door(self, rid: int) -> None:
         eta = (self.health.quarantined_eta_s()
@@ -161,13 +245,31 @@ class Router:
             self._router_shed.pop(next(iter(self._router_shed)))
 
     def submit(self, req: Request) -> int:
-        i = self._pick()
         # the fleet-global rid IS the request's trace id: every span the
         # replica scheduler and engine record for it carries this one id,
         # so a request renders end-to-end across the tiers in Perfetto.
         # Increment only after the replica ACCEPTED — a rejected submit
         # (over-long prompt) must not consume a fleet id.
         rid = self._next_id
+        if self._wants_prefill_replica(req):
+            i = self._pick("prefill")
+            if i is None:
+                self._next_id += 1
+                self._shed_at_door(rid)
+                return rid
+            # the PREFILL JOB: same prompt/sampling/deadlines, one token —
+            # its whole value is the page-save side effect. The original
+            # request rides self._handoff until the job is terminal.
+            t0 = self.clock()
+            job = dataclasses.replace(req, max_new=1)
+            local = self.schedulers[i].submit(job, trace_id=rid,
+                                              submit_t=t0)
+            self._next_id += 1
+            self._where[rid] = (i, local)
+            self._handoff[rid] = (req, t0)
+            self._handoffs += 1
+            return rid
+        i = self._pick()
         if i is None:
             # nothing routable: shed at the front door with the earliest
             # probation ETA as the honest retry hint
@@ -178,6 +280,36 @@ class Router:
         self._next_id += 1
         self._where[rid] = (i, local)
         return rid
+
+    def _promote_handoffs(self) -> None:
+        """Move every finished prefill job's ORIGINAL request onto a
+        decode replica. ``done`` promotes (the pages are saved; the
+        decode admission gathers them) and so does ``shed`` (the prefill
+        queue was full — the decode tier may still have room, where the
+        request prefills from scratch). A ``timeout``/``error`` job is
+        ADOPTED as the request's own verdict instead: the deadline was
+        measured from the original submit and a poisoned prefill raises
+        wherever it lands, so a decode-side replay could only repeat the
+        same outcome while double-counting it in fleet stats — poll()
+        keeps reading the job's terminal record through ``_where``."""
+        if not self._handoff:
+            return
+        for rid in list(self._handoff):
+            i, local = self._where[rid]
+            st = self.schedulers[i].poll(local)
+            if st["status"] not in ("done",) + FAILED_STATUSES:
+                continue
+            req, t0 = self._handoff.pop(rid)
+            if st["status"] in ("timeout", "error"):
+                continue               # adopted verdict; record retained
+            self.schedulers[i].release(local)   # drop a DONE job's record
+            j = self._pick()
+            if j is None:
+                self._shed_at_door(rid)
+                continue
+            local2 = self.schedulers[j].submit(req, trace_id=rid,
+                                               submit_t=t0)
+            self._where[rid] = (j, local2)
 
     def replica_of(self, rid: int) -> int:
         """Which replica holds request ``rid`` (admission audit)."""
@@ -221,8 +353,13 @@ class Router:
         routable survivor the request sheds at the front door."""
         for rec in self.schedulers[i].evict_for_requeue():
             rid = rec.trace_id     # the fleet-global id (we threaded it)
-            j = self._pick()       # never i: quarantined is not routable
+            # a drained prefill JOB stays in its phase: re-route it to a
+            # surviving prefill replica (or, via _pick's role fallback,
+            # anywhere routable when the whole prefill tier is down)
+            phase = "prefill" if rid in self._handoff else "decode"
+            j = self._pick(phase)  # never i: quarantined is not routable
             if j is None:
+                self._handoff.pop(rid, None)
                 self._shed_at_door(rid)
                 continue
             local = self.schedulers[j].submit(
@@ -265,6 +402,7 @@ class Router:
             for s in self.schedulers:
                 if s.pending:
                     s.tick()
+            self._promote_handoffs()
             return
         for i, s in enumerate(self.schedulers):
             if not h.routable(i):
@@ -284,6 +422,7 @@ class Router:
                 continue
             if h.note_tick(i, self.clock() - t0) == health_lib.QUARANTINED:
                 self._requeue_from(i)
+        self._promote_handoffs()
 
     def run_until_idle(self, max_ticks: int = 100000, *,
                        on_tick=None) -> None:
@@ -299,6 +438,11 @@ class Router:
         shed = self._router_shed.get(rid)
         if shed is not None:
             return dict(shed)
+        if rid in self._handoff:
+            # prefill phase of a disaggregated request: the job's local
+            # statuses (and its one sampled token) are plumbing — the
+            # caller sees a request that is still prefilling
+            return {"status": "prefill", "tokens": []}
         i, local = self._where[rid]
         return self.schedulers[i].poll(local)
 
@@ -354,6 +498,11 @@ class Router:
         out["router_request_errors"] = float(
             sum(s._request_errors for s in self.schedulers))
         out["router_requeued"] = float(self._requeued)
+        if self._prefill_replicas:
+            out["router_prefill_replicas"] = float(self._prefill_replicas)
+            out["router_handoffs"] = float(self._handoffs)
+            for i, role in enumerate(self._roles):
+                out[f"replica{i}_role"] = role
         if self.health is not None:
             hc = self.health.counters
             out["router_quarantines"] = float(hc["quarantines"])
@@ -361,7 +510,14 @@ class Router:
             out["router_replica_faults"] = float(hc["faults"])
             for i in range(n):
                 out[f"replica{i}_health"] = self.health.state(i)
-        ttfts = [t for s in self.schedulers for t in s._ttfts]
+        # fleet TTFT: with disaggregation on, prefill-role schedulers'
+        # samples are JOB latencies (plumbing), not user-visible first
+        # tokens — the decode replicas record the real TTFT (measured
+        # from the ORIGINAL submit via the threaded submit_t)
+        ttfts = [t for i, s in enumerate(self.schedulers)
+                 if not (self._prefill_replicas
+                         and self._roles[i] == "prefill")
+                 for t in s._ttfts]
         out["router_ttft_p50_s"] = _quantile(ttfts, 0.5)
         out["router_ttft_p99_s"] = _quantile(ttfts, 0.99)
         if self.ttft_slo_s > 0.0:

@@ -121,6 +121,14 @@ class _Rec:
     #: clock legitimately stamps first tokens at t == 0.0, and a falsy
     #: check would re-arm the TTFT deadline on an actively-decoding row
     first_token_t: Optional[float] = None
+    #: TTFT in scheduler TICKS (submit_tick → first_token_tick): the
+    #: per-replica clock. On the single-process CPU sim every replica's
+    #: wall time shares one thread, so wall TTFT charges a replica for
+    #: the whole fleet's work; tick counts are what a real parallel
+    #: fleet's wall clock would see (the disaggregation benches/tests
+    #: compare on these).
+    submit_tick: int = 0
+    first_token_tick: Optional[int] = None
     finish_t: float = 0.0
     #: pinned prefix-page chain (engine.prefix_match) — pages loaded so
     #: far, released on slot evict (the refcount contract).
@@ -214,6 +222,10 @@ class Scheduler:
         self._request_errors = 0
         self._requeued_out = 0
         self._requeued_in = 0
+        # speculative-decode acceptance over RUNNING slots only (the
+        # engine's own counters also see stale still-active rows)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         # deadline sweeps only run once a deadlined request has been seen
         self._any_deadlines = False
 
@@ -238,6 +250,7 @@ class Scheduler:
         self._next_id += 1
         rec = _Rec(rid, req, requeued=requeued,
                    submit_t=self.clock() if submit_t is None else submit_t,
+                   submit_tick=self._tick,
                    trace_id=rid if trace_id is None else trace_id)
         tracer = self._tracer()
         if tracer is not None:
@@ -360,6 +373,7 @@ class Scheduler:
                         self._fail(rec, e)
                         continue
                 rec.first_token_t = self.clock()
+                rec.first_token_tick = self._tick
                 rec.tokens.append(tok)
                 self._admitting = None
                 self._ttfts.append(rec.first_token_t - rec.submit_t)
@@ -374,21 +388,39 @@ class Scheduler:
                     and not getattr(self.engine, "annotate_traces", False):
                 # hottest loop, telemetry off: no per-token id-list /
                 # targs allocation for data nothing would consume
-                toks, dones = self.engine.decode()
+                out = self.engine.decode()
             else:
                 active = [r.trace_id for r in self._running.values()]
                 ekw = ({"trace_ids": active}
                        if getattr(self.engine, "annotate_traces", False)
                        else {})
-                toks, dones = self._timed(
+                out = self._timed(
                     "serve_decode", self.engine.decode,
                     targs={"trace_ids": active}, **ekw)
             now = self.clock()
-            for slot, rec in list(self._running.items()):
-                rec.tokens.append(int(toks[slot]))
-                if bool(dones[slot]) or self._budget_spent(rec):
-                    rec.finish_t = now
-                    self._finish(rec)
+            spec_k = getattr(self.engine, "spec_k", 0)
+            if spec_k:
+                # SPECULATIVE tick: up to k+1 tokens per slot, delivered
+                # in order until the row's eos or budget — exactly the
+                # sequence n_emit plain ticks would have delivered.
+                toks, dones, n_emit = out
+                for slot, rec in list(self._running.items()):
+                    n = int(n_emit[slot])
+                    self._spec_proposed += spec_k
+                    self._spec_accepted += n - 1
+                    for j in range(n):
+                        rec.tokens.append(int(toks[slot, j]))
+                        if bool(dones[slot, j]) or self._budget_spent(rec):
+                            rec.finish_t = now
+                            self._finish(rec)
+                            break
+            else:
+                toks, dones = out
+                for slot, rec in list(self._running.items()):
+                    rec.tokens.append(int(toks[slot]))
+                    if bool(dones[slot]) or self._budget_spent(rec):
+                        rec.finish_t = now
+                        self._finish(rec)
         self._occupancy_sum += self._occupancy()
 
         if (self.writer is not None and self.log_every
@@ -656,6 +688,9 @@ class Scheduler:
             "serve_tok_latency_p50_s": _quantile(self._tok_lats, 0.5),
             "serve_tok_latency_p99_s": _quantile(self._tok_lats, 0.99),
         })
+        if self._spec_proposed:
+            out["serve_spec_accept_rate"] = (self._spec_accepted
+                                             / self._spec_proposed)
         if self.ttft_slo_s > 0.0:
             out["serve_ttft_slo_ok_frac"] = (
                 sum(1 for t in self._ttfts if t <= self.ttft_slo_s)

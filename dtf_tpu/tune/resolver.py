@@ -74,6 +74,20 @@ class LossPathPlan:
     measured: bool
 
 
+#: speculative-decode draft width when no winner is banked: proposals are
+#: cheap relative to a verify pass and acceptance decays with depth, so a
+#: mid-size default loses little either way (the bench_decode draft-k
+#: sweep banks the measured per-(model, draft, slots) winner over it).
+FALLBACK_SPEC_K = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class SpecKPlan:
+    k: int
+    source: str
+    measured: bool
+
+
 @functools.lru_cache(maxsize=1024)
 def flash_plan(*, seq: int, heads: int, head_dim: int, dtype: str,
                causal: bool, window: int, n_devices: int = 1,
@@ -142,6 +156,23 @@ def lm_loss_winner(*, fits: bool, vocab: int, seq: int, batch: int,
 
 
 @functools.lru_cache(maxsize=256)
+def spec_k_plan(*, model: str, draft: str, n_slots: int,
+                backend: Optional[str] = None) -> SpecKPlan:
+    """The tuned speculative draft width for one (model, draft, slots)
+    serving shape — ``DecodeEngine``'s 0-sentinel ``spec_k`` resolves
+    here; an explicit ``--spec_k`` wins with a warn-once when it
+    overrides a measured winner (``note_override``). Model/draft are
+    architecture labels (hard-matched: a k measured for one pair never
+    resolves for another); ``n_slots`` is soft (nearest batch)."""
+    key = dict(model=model, draft=draft, n_slots=n_slots, backend=backend)
+    e = _cache.load_store().lookup("spec_k", key)
+    if e is None or "k" not in e.winner:
+        return SpecKPlan(FALLBACK_SPEC_K, FALLBACK_SOURCE, False)
+    return SpecKPlan(k=int(e.winner["k"]), source=e.source,
+                     measured=e.measured)
+
+
+@functools.lru_cache(maxsize=256)
 def _warn_override_once(kind: str, what: str, explicit: str,
                         winner: str, source: str) -> None:
     try:
@@ -169,6 +200,7 @@ def _clear_plans() -> None:
     flash_plan.cache_clear()
     fused_ce_plan.cache_clear()
     lm_loss_winner.cache_clear()
+    spec_k_plan.cache_clear()
     _warn_override_once.cache_clear()
 
 

@@ -286,6 +286,69 @@ def seed_lm_loss_entries(root: str) -> list[Entry]:
     return entries
 
 
+def seed_spec_k_entries(root: str) -> list[Entry]:
+    """spec_k winners per (model, draft, slots, backend) from the serve
+    sweep rows (``bench_decode --sweep-serve`` draft-k axis, merged under
+    BENCH_LM.json "serve"): best GOODPUT tokens/sec among the swept k
+    values on the same seeded arrivals. Rows carry the architecture
+    labels the engine's resolver queries (``model_arch``/``draft_arch``
+    — serve/engine.py ``_cfg_label``), so a banked winner lands exactly
+    where ``DecodeEngine(spec_k=0)`` will look."""
+    rows = list((_read_json(os.path.join(root, "BENCH_LM.json"))
+                 .get("serve") or {}).get("rows", []))
+    groups: dict[tuple, list[dict]] = {}
+    for r in rows:
+        k = int(r.get("spec_k", 0) or 0)
+        if not k or not r.get("model_arch") or not r.get("draft_arch"):
+            continue
+        serve = r.get("serve") or {}
+        if not isinstance(serve.get("tokens_per_sec"), (int, float)):
+            continue
+        slots = int(r.get("n_slots", 0)) // max(int(r.get("replicas", 1)
+                                                    or 1), 1)
+        gk = (str(r["model_arch"]), str(r["draft_arch"]), slots,
+              str(r.get("backend", "tpu")))
+        groups.setdefault(gk, []).append({
+            "k": k, "tokens_per_sec": float(serve["tokens_per_sec"]),
+            "accept_rate": serve.get("accept_rate")})
+    entries: list[Entry] = []
+    for (m, d, slots, backend), brows in sorted(groups.items()):
+        best = select_winner(brows, metric="tokens_per_sec",
+                             lower_is_better=False)
+        if best is None:
+            continue
+        entries.append(Entry(
+            kind="spec_k",
+            key=dict(model=m, draft=d, n_slots=slots, backend=backend),
+            winner={"k": int(best["k"])},
+            metric={"tokens_per_sec": best["tokens_per_sec"],
+                    "accept_rate": best.get("accept_rate"),
+                    "alternatives": {f"k{b['k']}": b["tokens_per_sec"]
+                                     for b in brows}},
+            source=("BENCH_LM.json serve rows (bench_decode "
+                    "--sweep-serve draft-k axis): best goodput on the "
+                    "same seeded arrivals"),
+            measured=True))
+    return entries
+
+
+def spec_policy_entries() -> list[Entry]:
+    """The flagship (gpt2_small, gpt2_draft) spec_k default until the
+    on-chip draft-k sweep banks: k=4 — acceptance on natural text decays
+    with depth while verify cost grows with k+1, and 4 is the center of
+    the swept grid (2/4/8). measured=False: the resolver uses it but an
+    explicit --spec_k never warns about overriding a guess."""
+    return [Entry(
+        kind="spec_k",
+        key=dict(model="d768L12h12kv12v50304",     # gpt2_small
+                 draft="d384L3h6kv6v50304",        # gpt2_draft
+                 n_slots=8, backend="tpu"),
+        winner={"k": 4},
+        source=("policy default pending the queued bench_decode "
+                "--sweep-serve draft-k rows (re-seed after they bank)"),
+        measured=False)]
+
+
 def cpu_sim_fallback_entries() -> list[Entry]:
     """Deterministic CPU-sim entries mirroring the built-in defaults.
 
@@ -315,5 +378,9 @@ def seed_entries(root: Optional[str] = None) -> list[Entry]:
     from dtf_tpu.tune.cache import repo_root
 
     root = root or repo_root()
-    return (seed_flash_entries(root) + seed_lm_loss_entries(root)
+    # policy entries FIRST: merge_entries is last-wins per canonical key,
+    # so a measured spec_k row banking at the policy's exact key replaces
+    # the guess instead of being shadowed by it.
+    return (spec_policy_entries() + seed_flash_entries(root)
+            + seed_lm_loss_entries(root) + seed_spec_k_entries(root)
             + cpu_sim_fallback_entries())

@@ -154,11 +154,21 @@ def child_serve():
     from dtf_tpu.serve import (DecodeEngine, HealthConfig, PoissonLoadGen,
                                Router, Scheduler, install_serve_fault,
                                replay)
+    from dtf_tpu.serve.engine import _cfg_label
     from dtf_tpu.serve.scheduler import _quantile
 
     tiny = os.environ.get("DTF_DECODE_TINY") == "1"
     if tiny:
-        base = gpt.GPTConfig.tiny(dtype=jax.numpy.bfloat16)
+        # DTF_SERVE_F32 (optional diagnostic knob, not set by the sweep):
+        # run the tiny model at f32 when an UNTRAINED bf16 model's
+        # near-tie logits flip argmax between the draft's single-token
+        # steps and the verifier's batched pass (matmul-shape rounding)
+        # and deflate acceptance — a failure mode a trained checkpoint
+        # does not have. The shipped spec rows measure ~0.99 acceptance
+        # at bf16 (self-draft), so they run bf16 like everything else.
+        dt = (jax.numpy.float32 if os.environ.get("DTF_SERVE_F32") == "1"
+              else jax.numpy.bfloat16)
+        base = gpt.GPTConfig.tiny(dtype=dt)
         n_slots, t_p, new_min, new_max = 4, 48, 4, 16
         rate, n_req, chunk, page = 200.0, 12, 8, 8
     else:
@@ -170,11 +180,44 @@ def child_serve():
     replicas = int(os.environ.get("DTF_SERVE_REPLICAS", "1"))
     hit_ratio = float(os.environ.get("DTF_SERVE_PREFIX", "0"))
     page = int(os.environ.get("DTF_SERVE_PAGE", page))
-    max_len = t_p + new_max
+    t_p = int(os.environ.get("DTF_SERVE_TP", t_p))
+    new_min = int(os.environ.get("DTF_SERVE_NEW_MIN", new_min))
+    new_max = int(os.environ.get("DTF_SERVE_NEW_MAX", new_max))
+    budget = int(os.environ.get("DTF_SERVE_BUDGET", "4"))
+    # ISSUE 13 axes: draft width (0 = speculation off) and disaggregation
+    # ratio (dedicated prefill replicas out of `replicas`).
+    spec_k = int(os.environ.get("DTF_SERVE_SPEC_K", "0"))
+    draft_mode = os.environ.get("DTF_SERVE_DRAFT", "self")
+    prefill_reps = int(os.environ.get("DTF_SERVE_PREFILL_REPLICAS", "0"))
+    # long-prompt BURST (the disaggregation row's workload): a contiguous
+    # run of requests mid-stream carries a LONG unique prompt; the row
+    # then reports short-request TTFT separately — the starvation metric
+    # phase routing exists to fix. (No static side on mixed-length rows —
+    # fixed-batch serving cannot mix prompt lengths at all.)
+    long_frac = float(os.environ.get("DTF_SERVE_LONG", "0"))
+    t_p_long = int(os.environ.get("DTF_SERVE_TP_LONG", str(4 * t_p)))
+    max_len = (max(t_p, t_p_long) if long_frac > 0 else t_p) + new_max
+    max_len = -(-max_len // page) * page    # pages tile the cache
     cfg = dataclasses.replace(base, decode_len=max_len)
     model = gpt.GPT(cfg, None)
     params = model.init(jax.random.PRNGKey(0),
                         jax.numpy.zeros((1, 1), jax.numpy.int32))["params"]
+    draft_cfg = draft_params = None
+    if spec_k:
+        if draft_mode == "half":
+            # early-exit draft: half the layers of the measured model —
+            # realistic proposal cost, random-init acceptance on the sim
+            draft_cfg, draft_params = gpt.draft_truncate(
+                base, params, max(1, base.layers // 2))
+        else:
+            # self-draft: draft == target, the 100%-greedy-acceptance
+            # upper bound — measures the speculation MACHINERY (one
+            # k-step dispatch + one k+1-wide verify vs k+1 dispatches),
+            # not a distilled draft's quality
+            draft_cfg, draft_params = base, params
+    if prefill_reps and hit_ratio <= 0:
+        raise SystemExit("DTF_SERVE_PREFILL_REPLICAS needs "
+                         "DTF_SERVE_PREFIX > 0 (the page transport)")
     gen = PoissonLoadGen(rate=rate, n_requests=n_req,
                          vocab_size=base.vocab_size, prompt_min=t_p,
                          prompt_max=t_p, new_min=new_min, new_max=new_max,
@@ -182,8 +225,12 @@ def child_serve():
     arrivals = list(gen.arrivals())
     if hit_ratio > 0:
         # a seeded fraction of requests shares one prompt stem (system-
-        # prompt traffic shape): ~3/4 of the prompt, page-aligned
-        stem_len = (3 * t_p // 4) // page * page
+        # prompt traffic shape): ~3/4 of the prompt by default,
+        # page-aligned; DTF_SERVE_STEM_FRAC deepens it (the spec rows
+        # model long-system-prompt traffic where nearly all prefill is
+        # the shared stem)
+        stem_frac = float(os.environ.get("DTF_SERVE_STEM_FRAC", "0.75"))
+        stem_len = int(t_p * stem_frac) // page * page
         stem = np.random.default_rng(7).integers(
             0, base.vocab_size, stem_len).tolist()
         pick = np.random.default_rng(8).random(n_req) < hit_ratio
@@ -192,6 +239,25 @@ def child_serve():
                 req, prompt=stem + list(req.prompt[stem_len:]))
              if pick[i] else req)
             for i, (t, req) in enumerate(arrivals)]
+    long_ids: set = set()
+    if long_frac > 0:
+        # the BURST: a contiguous run of UNIQUE long prompts starting a
+        # quarter into the stream — prefill-heavy work that, without
+        # disaggregation, competes with every short request's decode
+        n_long = max(1, int(round(long_frac * n_req)))
+        start_i = n_req // 4
+        lrng = np.random.default_rng(9)
+        t_burst = arrivals[start_i][0]
+        for i in range(start_i, min(start_i + n_long, n_req)):
+            # summarization-shaped (long unique input, SHORT output — the
+            # canonical disaggregation workload) and SIMULTANEOUS: the
+            # whole burst lands at one instant, the head-of-line pile-up
+            # that starves a shared fleet's admission queues
+            arrivals[i] = (t_burst, dataclasses.replace(
+                arrivals[i][1], max_new=max(new_min, 8),
+                prompt=lrng.integers(0, base.vocab_size,
+                                     t_p_long).tolist()))
+            long_ids.add(i)
 
     # slots split across replicas: capacity-constant routing A/B
     if n_slots % replicas:
@@ -207,13 +273,29 @@ def child_serve():
     fault_plan = ServeFaultPlan.from_env()
     fault_queue = n_slots if fault_plan is not None else 0
 
-    def serve_side(prefix_on, inject=False):
+    def serve_side(prefix_on, inject=False, disagg=0, spec_on=True):
+        use_spec = spec_k if spec_on else 0
         pool = (max_len // page) * 2 if prefix_on else 0
-        engines = [DecodeEngine(base, params, n_slots=n_slots // replicas,
-                                max_len=max_len, prefill_chunk=chunk,
-                                kv_page_size=page if prefix_on else 0,
-                                prefix_pages=pool)
-                   for _ in range(replicas)]
+        # on a disaggregation ROW, both sides get eager saves AND the
+        # shared store — the off side must differ ONLY in phase routing,
+        # not in save admission or pool visibility, or the ttft_short
+        # delta partly measures the wrong mechanism
+        share = prefill_reps > 0 and prefix_on
+        engines, store = [], None
+        for r in range(replicas):
+            pre = r < disagg
+            engines.append(DecodeEngine(
+                base, params, n_slots=n_slots // replicas,
+                max_len=max_len, prefill_chunk=chunk,
+                kv_page_size=page if prefix_on else 0,
+                prefix_pages=pool,
+                page_save_after=1 if share else 2, shared_pages=store,
+                draft_cfg=None if (pre or not use_spec) else draft_cfg,
+                draft_params=None if (pre or not use_spec)
+                else draft_params,
+                spec_k=0 if pre else use_spec))
+            if share and store is None:
+                store = engines[0].page_store
         for e in engines:
             # warm every program outside the timed window (the static
             # side's fence(run(...)) move): first-call backend overhead
@@ -231,10 +313,11 @@ def child_serve():
                                probation_delay_s=3600.0)
                   if fault_plan is not None and replicas > 1 else False)
         if replicas > 1:
-            sched = Router(engines, None, prefill_chunks_per_tick=4,
-                           health=health, max_queue=fault_queue)
+            sched = Router(engines, None, prefill_chunks_per_tick=budget,
+                           health=health, max_queue=fault_queue,
+                           prefill_replicas=disagg)
         else:
-            sched = Scheduler(engines[0], None, prefill_chunks_per_tick=4,
+            sched = Scheduler(engines[0], None, prefill_chunks_per_tick=budget,
                               max_queue=fault_queue)
         if inject:
             # wedge sleeps are real wall time (the watchdog quarantines
@@ -270,6 +353,57 @@ def child_serve():
                "pages_loaded": counters["pages_loaded"],
                "pages_saved": counters["pages_saved"],
                "prefix_hit_tokens": counters["prefix_hit_tokens"]}
+        if use_spec:
+            prop = counters.get("spec_proposed", 0)
+            out["decode_steps"] = counters["decode_steps"]
+            out["accept_rate"] = (round(counters["spec_accepted"] / prop, 4)
+                                  if prop else 0.0)
+            out["draft_fallbacks"] = counters.get("draft_fallbacks", 0)
+        if disagg:
+            out["handoffs"] = st.get("router_handoffs", 0.0)
+        if long_ids:
+            # per-class TTFT: the SHORT requests' tail is the starvation
+            # metric — the burst must not inflate it fleet-wide. Reported
+            # in WALL seconds and in per-replica TICKS: on this
+            # single-process sim every replica shares one thread, so wall
+            # TTFT charges a replica for the whole fleet's work — tick
+            # counts are what a real parallel fleet's clock would see,
+            # and they are what the disaggregation claim rides on.
+            def req_rec(rid):
+                if hasattr(sched, "_where"):          # Router
+                    if rid in getattr(sched, "_router_shed", {}):
+                        return None
+                    loc = sched._where.get(rid)
+                    return (None if loc is None else
+                            sched.schedulers[loc[0]]._recs.get(loc[1]))
+                return sched._recs.get(rid)
+
+            def req_ttft(rid):
+                rec = req_rec(rid)
+                if rec is None or rec.first_token_t is None:
+                    return None
+                return rec.first_token_t - rec.submit_t
+
+            def req_ttft_ticks(rid):
+                rec = req_rec(rid)
+                if rec is None or rec.first_token_tick is None:
+                    return None
+                return rec.first_token_tick - rec.submit_tick
+
+            shorts = [t for r in range(n_req) if r not in long_ids
+                      if (t := req_ttft(r)) is not None]
+            longs = [t for r in sorted(long_ids)
+                     if (t := req_ttft(r)) is not None]
+            short_ticks = [t for r in range(n_req) if r not in long_ids
+                           if (t := req_ttft_ticks(r)) is not None]
+            if shorts:
+                out["ttft_short_p50_s"] = round(_quantile(shorts, 0.5), 5)
+                out["ttft_short_p99_s"] = round(_quantile(shorts, 0.99), 5)
+            if short_ticks:
+                out["ttft_short_p50_ticks"] = _quantile(short_ticks, 0.5)
+                out["ttft_short_p99_ticks"] = _quantile(short_ticks, 0.99)
+            if longs:
+                out["ttft_long_p99_s"] = round(_quantile(longs, 0.99), 5)
         if fault_plan is not None:
             shed = st.get("router_shed", st.get("serve_shed", 0.0))
             out["statuses"] = statuses
@@ -280,45 +414,68 @@ def child_serve():
             out["requeued"] = st.get("router_requeued", 0.0)
         return out
 
-    # ---- serve side: open-loop Poisson against the engine/router fleet
-    serve = serve_side(prefix_on=hit_ratio > 0)
-    serve_off = serve_side(prefix_on=False) if hit_ratio > 0 else None
+    # ---- serve side: open-loop Poisson against the engine/router fleet.
+    # The in-row A/B partner depends on the swept axis: a disaggregation
+    # row compares against the SAME pages with routing off, a prefix row
+    # against pages off, a spec row against speculation off — always the
+    # same seeded arrivals.
+    serve = serve_side(prefix_on=hit_ratio > 0, disagg=prefill_reps)
+    if prefill_reps:
+        serve_off = serve_side(prefix_on=True, disagg=0)
+    elif spec_k:
+        serve_off = serve_side(prefix_on=hit_ratio > 0, spec_on=False)
+    elif hit_ratio > 0:
+        serve_off = serve_side(prefix_on=False)
+    else:
+        serve_off = None
     serve_degraded = (serve_side(prefix_on=hit_ratio > 0, inject=True)
                       if fault_plan is not None else None)
 
     # ---- static side: same arrivals, fixed batches, worst-case decode.
     # TTFT for a static server is delivery time: batch end - arrival (a
     # request's tokens only return when its whole batch completes).
-    run = jax.jit(lambda p, ids: gpt.generate(model, p, ids, new_max))
-    warm_ids = jax.numpy.zeros((n_slots, t_p), jax.numpy.int32)
-    fence(run(params, warm_ids))                      # compile outside t0
-    t0 = time.perf_counter()
-    done_t, end = [], 0.0
-    for b0 in range(0, n_req, n_slots):
-        batch = arrivals[b0:b0 + n_slots]
-        now = time.perf_counter() - t0
-        start = max(end, batch[-1][0])                # wait for the batch
-        if start > now:
-            time.sleep(start - now)
-        ids = np.zeros((n_slots, t_p), np.int32)
-        for j, (_, req) in enumerate(batch):
-            ids[j] = req.prompt
-        fence(run(params, jax.numpy.asarray(ids)))
-        end = time.perf_counter() - t0
-        done_t += [end - arr for arr, _ in batch]
-    static_wall = end
-    want = sum(req.max_new for _, req in arrivals)    # goodput: wanted only
-    # same rank definition as the serve side's scheduler stats — a hand-
-    # rolled quantile here would bias the A/B by one rank at small N
-    static = {"tokens_per_sec": round(want / max(static_wall, 1e-9), 1),
-              "makespan_s": round(static_wall, 3),
-              "ttft_p50_s": round(_quantile(done_t, 0.5), 5),
-              "ttft_p99_s": round(_quantile(done_t, 0.99), 5)}
+    # Mixed-length burst rows have no static side at all — a fixed-batch
+    # server cannot mix prompt lengths, which is half the point.
+    if long_ids:
+        static = {"skipped": "mixed prompt lengths"}
+    else:
+        run = jax.jit(lambda p, ids: gpt.generate(model, p, ids, new_max))
+        warm_ids = jax.numpy.zeros((n_slots, t_p), jax.numpy.int32)
+        fence(run(params, warm_ids))                  # compile outside t0
+        t0 = time.perf_counter()
+        done_t, end = [], 0.0
+        for b0 in range(0, n_req, n_slots):
+            batch = arrivals[b0:b0 + n_slots]
+            now = time.perf_counter() - t0
+            start = max(end, batch[-1][0])            # wait for the batch
+            if start > now:
+                time.sleep(start - now)
+            ids = np.zeros((n_slots, t_p), np.int32)
+            for j, (_, req) in enumerate(batch):
+                ids[j] = req.prompt
+            fence(run(params, jax.numpy.asarray(ids)))
+            end = time.perf_counter() - t0
+            done_t += [end - arr for arr, _ in batch]
+        static_wall = end
+        want = sum(req.max_new for _, req in arrivals)   # goodput: wanted
+        # same rank definition as the serve side's scheduler stats — a
+        # hand-rolled quantile would bias the A/B by one rank at small N
+        static = {"tokens_per_sec": round(want / max(static_wall, 1e-9), 1),
+                  "makespan_s": round(static_wall, 3),
+                  "ttft_p50_s": round(_quantile(done_t, 0.5), 5),
+                  "ttft_p99_s": round(_quantile(done_t, 0.99), 5)}
 
     row = {"model": ("gpt_tiny" if tiny else "gpt2_small") + "_serve_ab",
            "backend": jax.default_backend(), "n_slots": n_slots,
            "replicas": replicas, "prefix_hit_ratio": hit_ratio,
            "page_size": page if hit_ratio > 0 else 0,
+           "spec_k": spec_k, "draft": draft_mode if spec_k else "",
+           "prefill_replicas": prefill_reps,
+           "long_frac": long_frac, "t_p_long": t_p_long if long_frac else 0,
+           # architecture labels keying the tuner's spec_k winner
+           # selection (tune/search.py seed_spec_k_entries)
+           "model_arch": _cfg_label(base),
+           "draft_arch": _cfg_label(draft_cfg) if spec_k else "",
            "prompt": t_p, "new_min": new_min, "new_max": new_max,
            "rate_rps": rate, "n_requests": n_req, "prefill_chunk": chunk,
            "serve": serve, "static": static}
@@ -375,6 +532,7 @@ def main(key="decode"):
             _merge(rows, errors, key="serve")
             print(json.dumps(row if row is not None else errors[-1]))
 
+        tiny = os.environ.get("DTF_DECODE_TINY") == "1"
         serve_jobs = [
             {},                                       # 1 replica, no stems
             {"DTF_SERVE_PREFIX": "0.75"},             # prefix cache A/B
@@ -385,6 +543,40 @@ def main(key="decode"):
             # goodput/TTFT p99/shed fraction both sides in one row
             {"DTF_SERVE_REPLICAS": "2",
              "DTF_FAULT_INJECT": "wedge_replica@6:replica=1"},
+            # ISSUE 13: draft-k sweep — each row carries a spec-off side
+            # on the same arrivals; self-draft is the acceptance upper
+            # bound (measures the machinery), and the tuner's spec_k
+            # winner selection reads the best-goodput row of this sweep.
+            # The tiny/CPU-sim rows run the DEEP-CACHE shape (long shared
+            # stems via prefix pages — self-spec page loads shortcut the
+            # draft prefill too — so every verified token sits deep in
+            # the cache): the regime where a verify pass amortizes the
+            # per-step cache read across k+1 queries — the only axis on
+            # which the compute-bound sim reproduces the chip's
+            # memory-bound win (measured crossover ~L=512 on the sim).
+            *({"DTF_SERVE_SPEC_K": k,
+               **({"DTF_SERVE_TP": "448", "DTF_SERVE_PREFIX": "1.0",
+                   "DTF_SERVE_STEM_FRAC": "0.95", "DTF_SERVE_N": "32",
+                   "DTF_SERVE_RATE": "400", "DTF_SERVE_NEW_MIN": "256",
+                   "DTF_SERVE_NEW_MAX": "256", "DTF_SERVE_BUDGET": "16"}
+                  if tiny else {})}
+              for k in ("2", "4", "8")),
+            # ISSUE 13: disaggregation — 1 of 2 replicas dedicated to
+            # prefill; SHORT stem-cached traffic (decode phase) with a
+            # simultaneous burst of LONG unique summarization-shaped
+            # prompts (prefill phase). The serve_off side is the same
+            # fleet with phase routing off; the claim rides the
+            # per-replica TICK TTFT columns (ttft_short_*_ticks): the
+            # burst's head-of-line admission pile-up must not inflate
+            # short-request decode TTFT — on the single-process sim the
+            # wall clock charges every replica for the whole fleet's
+            # work, so tick counts are the parallel-fleet-honest metric.
+            {"DTF_SERVE_REPLICAS": "2", "DTF_SERVE_PREFILL_REPLICAS": "1",
+             "DTF_SERVE_PREFIX": "1.0", "DTF_SERVE_STEM_FRAC": "0.95",
+             "DTF_SERVE_LONG": "0.33",
+             **({"DTF_SERVE_TP_LONG": "704", "DTF_SERVE_N": "24",
+                 "DTF_SERVE_RATE": "60", "DTF_SERVE_NEW_MIN": "8",
+                 "DTF_SERVE_NEW_MAX": "12"} if tiny else {})},
         ]
         rows, errors = run_budgeted_jobs(
             serve_jobs, child_argv(os.path.abspath(__file__)) + ["--serve"],

@@ -63,6 +63,25 @@ flags.DEFINE_integer("replicas", 1, "DecodeEngine replicas behind the "
                      "router: one restored param tree, independent KV "
                      "state each, least-occupancy admission with "
                      "queue-depth tiebreak (docs/SERVING.md)")
+flags.DEFINE_integer("prefill_replicas", 0, "prefill/decode "
+                     "disaggregation: the first N replicas are DEDICATED "
+                     "prefill replicas — long uncached prompts route "
+                     "there, their KV pages land in a SHARED page store "
+                     "(requires --prefix_pages) and decode replicas load "
+                     "them in one gather, so a long-prompt burst cannot "
+                     "starve fleet decode TTFT (docs/SERVING.md)")
+flags.DEFINE_integer("spec_k", 0, "speculative decoding: draft proposals "
+                     "per slot per tick (needs --draft_ckpt or "
+                     "--draft_layers; 0 with a draft = the kernel-tune "
+                     "winner decides, docs/TUNING.md; token streams stay "
+                     "identical to plain decode)")
+flags.DEFINE_string("draft_ckpt", "", "logdir of a SEPARATE draft-model "
+                    "checkpoint (its own manifest resolves the draft "
+                    "architecture; vocab must match the served model)")
+flags.DEFINE_integer("draft_layers", 0, "early-exit draft: reuse the "
+                     "first N layers of the SERVED checkpoint as the "
+                     "draft model — speculation without a second "
+                     "checkpoint (mutually exclusive with --draft_ckpt)")
 flags.DEFINE_integer("kv_page_size", 0, "prefix page width in tokens "
                      "(with --prefix_pages: must divide --max_len)")
 flags.DEFINE_integer("prefix_pages", 0, "prefix KV page-pool size per "
@@ -200,16 +219,63 @@ def main(argv):
     if sharded:
         params = shard_tree(params, mesh, gpt.tp_rules)
 
-    try:
-        engines = [DecodeEngine(cfg, params, n_slots=FLAGS.n_slots,
-                                max_len=FLAGS.max_len,
-                                prefill_chunk=FLAGS.prefill_chunk,
-                                mesh=mesh,
-                                kv_page_size=FLAGS.kv_page_size,
-                                prefix_pages=FLAGS.prefix_pages)
-                   for _ in range(FLAGS.replicas)]
-    except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
-        raise app.UsageError(str(e))
+    # speculative draft: a separate checkpoint (own manifest) or an
+    # early-exit truncation of the served one — either way the verifier
+    # samples every delivered token, so draft quality is a THROUGHPUT
+    # knob, never a correctness one.
+    draft_cfg = draft_params = None
+    if FLAGS.draft_ckpt and FLAGS.draft_layers:
+        raise app.UsageError(
+            "--draft_ckpt and --draft_layers are two ways to get ONE "
+            "draft model; pass exactly one")
+    if FLAGS.draft_ckpt:
+        dckpt_dir = os.path.join(FLAGS.draft_ckpt, "ckpt")
+        dmanifest = load_model_config(dckpt_dir)
+        if dmanifest is None:
+            raise app.UsageError(
+                f"--draft_ckpt={FLAGS.draft_ckpt} has no "
+                "model_config.json manifest; the draft architecture "
+                "cannot be guessed")
+        try:
+            dbase = gpt.GPTConfig.by_name(dmanifest.get("size", "draft"))
+        except KeyError as e:
+            raise app.UsageError(f"draft manifest size: {e.args[0]}")
+        draft_cfg = dataclasses.replace(
+            dbase,
+            kv_heads=dmanifest.get("kv_heads") or None,
+            attn_window=int(dmanifest.get("attn_window", 0) or 0),
+            attn_global_every=int(
+                dmanifest.get("attn_global_every", 0) or 0),
+            kv_cache_dtype=decode_cfg["kv_cache_dtype"])
+        dck = Checkpointer(dckpt_dir)
+        if dck.latest_step() is None:
+            raise app.UsageError(f"no checkpoint under {dckpt_dir}")
+        draft_params = dck.restore_params()
+        print(f"restored draft params of step {dck.last_restored_step} "
+              f"from {dckpt_dir}", file=sys.stderr)
+    elif FLAGS.draft_layers:
+        try:
+            draft_cfg, draft_params = gpt.draft_truncate(
+                cfg, params, FLAGS.draft_layers)
+        except ValueError as e:
+            raise app.UsageError(str(e))
+    if FLAGS.spec_k and draft_cfg is None:
+        raise app.UsageError(
+            f"--spec_k={FLAGS.spec_k} needs a draft model: pass "
+            "--draft_ckpt or --draft_layers")
+    if draft_params is not None and sharded and FLAGS.draft_ckpt:
+        draft_params = shard_tree(draft_params, mesh, gpt.tp_rules)
+    if FLAGS.prefill_replicas:
+        if not 0 < FLAGS.prefill_replicas < FLAGS.replicas:
+            raise app.UsageError(
+                f"--prefill_replicas={FLAGS.prefill_replicas} must leave "
+                f"at least one decode replica (--replicas="
+                f"{FLAGS.replicas})")
+        if not FLAGS.prefix_pages:
+            raise app.UsageError(
+                "--prefill_replicas needs --prefix_pages > 0: the page "
+                "pool is the prefill→decode KV transport")
+
     tel = None
     if FLAGS.telemetry or FLAGS.trace_out:
         from dtf_tpu.telemetry import Telemetry, TraceCollector
@@ -222,33 +288,57 @@ def main(argv):
                         out_dir=os.path.join(FLAGS.logdir, "telemetry"))
         if FLAGS.trace_out:
             tel.tracer = TraceCollector()
+    writer = MetricWriter(None, also_log=False)
+    try:
+        if FLAGS.replicas > 1:
+            from dtf_tpu.serve import HealthConfig, Router
+
+            health = False
+            if FLAGS.health:
+                overrides = {}
+                if FLAGS.health_slow_s > 0:
+                    overrides["min_slow_s"] = FLAGS.health_slow_s
+                if FLAGS.health_wedge_s > 0:
+                    overrides["wedge_s"] = FLAGS.health_wedge_s
+                if FLAGS.health_probation_s > 0:
+                    overrides["probation_delay_s"] = \
+                        FLAGS.health_probation_s
+                health = HealthConfig(**overrides)
+            # ONE fleet constructor: Router.build owns the role-dependent
+            # rules (shared page store on disaggregation, eager saves,
+            # no draft programs on prefill replicas)
+            sched = Router.build(
+                cfg, params, n_replicas=FLAGS.replicas,
+                n_slots=FLAGS.n_slots, max_len=FLAGS.max_len,
+                prefill_chunk=FLAGS.prefill_chunk, mesh=mesh,
+                kv_page_size=FLAGS.kv_page_size,
+                prefix_pages=FLAGS.prefix_pages,
+                draft_cfg=draft_cfg, draft_params=draft_params,
+                spec_k=FLAGS.spec_k,
+                prefill_replicas=FLAGS.prefill_replicas,
+                writer=writer, telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
+                health=health, max_queue=FLAGS.max_queue,
+                prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
+            engines = [s.engine for s in sched.schedulers]
+        else:
+            engines = [DecodeEngine(
+                cfg, params, n_slots=FLAGS.n_slots, max_len=FLAGS.max_len,
+                prefill_chunk=FLAGS.prefill_chunk, mesh=mesh,
+                kv_page_size=FLAGS.kv_page_size,
+                prefix_pages=FLAGS.prefix_pages, draft_cfg=draft_cfg,
+                draft_params=draft_params, spec_k=FLAGS.spec_k)]
+            sched = Scheduler(
+                engines[0], writer, log_every=0,
+                prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
+                telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
+                max_queue=FLAGS.max_queue)
+    except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
+        raise app.UsageError(str(e))
+    if tel is not None:
+        if FLAGS.trace_out:
             for e in engines:
                 e.annotate_traces = True
         tel.start()
-    writer = MetricWriter(None, also_log=False)
-    if FLAGS.replicas > 1:
-        from dtf_tpu.serve import HealthConfig, Router
-
-        health = False
-        if FLAGS.health:
-            overrides = {}
-            if FLAGS.health_slow_s > 0:
-                overrides["min_slow_s"] = FLAGS.health_slow_s
-            if FLAGS.health_wedge_s > 0:
-                overrides["wedge_s"] = FLAGS.health_wedge_s
-            if FLAGS.health_probation_s > 0:
-                overrides["probation_delay_s"] = FLAGS.health_probation_s
-            health = HealthConfig(**overrides)
-        sched = Router(
-            engines, writer, telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
-            health=health, max_queue=FLAGS.max_queue,
-            prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
-    else:
-        sched = Scheduler(
-            engines[0], writer, log_every=0,
-            prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
-            telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
-            max_queue=FLAGS.max_queue)
 
     # serve-side chaos (DTF_FAULT_INJECT=wedge_replica@tick:replica=k |
     # slow_decode@tick | poison_request@n) rides the launcher the way
@@ -332,6 +422,13 @@ def main(argv):
     out = {"mode": "requests" if FLAGS.requests else "poisson",
            "backend": jax.default_backend(), "step": step,
            "replicas": FLAGS.replicas,
+           "prefill_replicas": FLAGS.prefill_replicas,
+           # the RESOLVED draft width (decode replicas; 0 = spec off) —
+           # an unset --spec_k reports what the kernel-tune winner chose
+           "spec_k": engines[-1].spec_k,
+           "draft": ("ckpt" if FLAGS.draft_ckpt
+                     else f"layers:{FLAGS.draft_layers}"
+                     if FLAGS.draft_layers else ""),
            "request_statuses": statuses,
            "fault_inject": os.environ.get("DTF_FAULT_INJECT", "")
            if fault_plan is not None else "",

@@ -244,10 +244,14 @@ GOLDEN = runner.golden_path()
 # gpt_eval/gpt_prefill/gpt_pages complete the whole-inventory fence
 # (ISSUE 7): every AOT program in the system — eval step, serve
 # admission, page cache tick — fails tier-1 on drift, not just the
-# train steps and the decode view.
+# train steps and the decode view. gpt_serve_spec/gpt_serve_disagg
+# (ISSUE 13) fence the speculative tick (draft_all ∘ verify) and the
+# disaggregated prefill-replica admission (prefill ∘ page_save — the
+# page pool as KV transport).
 FAST_BUDGET_CONFIGS = ["mnist", "widedeep", "bert", "bert_accum",
                        "bert_grad_shard", "gpt_serve", "gpt_serve_int8",
-                       "gpt_eval", "gpt_prefill", "gpt_pages"]
+                       "gpt_eval", "gpt_prefill", "gpt_pages",
+                       "gpt_serve_spec", "gpt_serve_disagg"]
 
 
 @pytest.mark.parametrize("name", FAST_BUDGET_CONFIGS)

@@ -1,0 +1,279 @@
+"""Prefill/decode disaggregation (ISSUE 13): the page pool as a KV
+transport between dedicated prefill replicas and decode replicas, the
+phase-aware router, the starvation regression a long-prompt burst used to
+cause, and the split fleet's chaos behavior."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dtf_tpu.models import gpt
+from dtf_tpu.serve import DecodeEngine, HealthConfig, Request, Router
+
+CFG = gpt.GPTConfig.tiny(dtype=jnp.float32)
+MAX_LEN = 64
+PAGE = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    model = gpt.GPT(dataclasses.replace(CFG, decode_len=MAX_LEN))
+    return model.init(jax.random.PRNGKey(0),
+                      jnp.zeros((1, 1), jnp.int32))["params"]
+
+
+def _offline(params, req: dict) -> list[int]:
+    model = gpt.GPT(dataclasses.replace(CFG, decode_len=MAX_LEN))
+    out = gpt.generate(
+        model, params, jnp.asarray([req["prompt"]], jnp.int32),
+        req["max_new"], rng=jax.random.PRNGKey(req.get("seed", 0)),
+        temperature=req.get("temperature", 0.0),
+        top_k=req.get("top_k", 0), top_p=req.get("top_p", 1.0))
+    return np.asarray(out)[0, len(req["prompt"]):].tolist()
+
+
+def _fleet(params, *, n=2, prefill=1, health=False, **kw):
+    return Router.build(CFG, params, n_replicas=n, n_slots=2,
+                        max_len=MAX_LEN, prefill_chunk=5,
+                        kv_page_size=PAGE, prefix_pages=12,
+                        prefill_replicas=prefill, health=health, **kw)
+
+
+@pytest.fixture(scope="module")
+def fleet(params):
+    """One shared 1-prefill + 1-decode fleet for the read-mostly routed
+    tests (admission fully resets slots; page-pool state accumulating
+    across tests only ever SHORTENS later prefills — identity holds
+    either way by the PR 6 page contract)."""
+    return _fleet(params)
+
+
+# ------------------------------------------------------ shared page store
+
+@pytest.mark.slow
+def test_shared_page_store_is_a_transport(params):
+    """Pages saved by one engine are loadable by another mounting the
+    same store — and the loaded-KV decode stream is bitwise the offline
+    stream (the PR 6 page-identity contract, across engines)."""
+    a = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                     prefill_chunk=5, kv_page_size=PAGE, prefix_pages=12,
+                     page_save_after=1)
+    b = DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                     prefill_chunk=5, kv_page_size=PAGE, prefix_pages=12,
+                     page_save_after=1, shared_pages=a.page_store)
+    assert b.page_store is a.page_store
+    prompt = list(range(1, 22))           # 2 full pages + tail
+    # A prefills and saves the pages; B then HITS without ever having
+    # seen the prompt
+    a.prefill(0, prompt, seed=0)
+    a.save_prefix_pages(0, prompt)
+    h = b.prefix_match(prompt)
+    assert h is not None and h.n_tokens == 16
+    b.load_prefix(0, h)
+    tok0, _ = b.prefill(0, prompt, start=h.n_tokens, seed=5)
+    got = [tok0]
+    for _ in range(7):
+        toks, dones = b.decode()
+        got.append(int(toks[0]))
+    b.release_prefix(h)
+    want = _offline(params, dict(prompt=prompt, max_new=8, seed=5))
+    assert got == want
+    assert b.counters["pages_loaded"] == 2
+    assert a.prefix_stats()["pinned"] == 0
+
+
+def test_shared_store_compat_checks(params):
+    # a mismatched store built WITHOUT an engine (pure eval_shape — the
+    # check must fire before any device pool is gathered into a slot)
+    from dtf_tpu.serve import pages as pages_lib
+    from dtf_tpu.serve.engine import engine_state_struct
+
+    struct8 = engine_state_struct(
+        dataclasses.replace(CFG, kv_cache_dtype="int8"),
+        n_slots=2, max_len=MAX_LEN)
+    pool8 = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
+                         pages_lib.pool_abstract(struct8["cache"], 12,
+                                                 PAGE))
+    store8 = pages_lib.PageStore(pool8, pages_lib.PrefixIndex(12, PAGE))
+    with pytest.raises(ValueError, match="shared page pool"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                     prefill_chunk=5, kv_page_size=PAGE, prefix_pages=12,
+                     shared_pages=store8)
+    with pytest.raises(ValueError, match="shared_pages needs"):
+        DecodeEngine(CFG, params, n_slots=2, max_len=MAX_LEN,
+                     prefill_chunk=5, shared_pages=store8)
+
+
+# -------------------------------------------------------- routed identity
+
+def test_disagg_router_token_identity(params, fleet):
+    """The full disaggregated path — prefill replica saves, handoff,
+    decode replica gathers the chain and serves — is token-identical to
+    offline for greedy AND seeded sampling, and releases every pin."""
+    router = fleet
+    rng = np.random.default_rng(2)
+    reqs = []
+    for i in range(5):
+        t_p = int(rng.integers(3, 40))
+        reqs.append(dict(prompt=rng.integers(0, CFG.vocab_size,
+                                             t_p).tolist(),
+                         max_new=int(rng.integers(2, 10)),
+                         temperature=0.0 if i % 2 else 0.8, seed=40 + i))
+    rids = [router.submit(Request(**r)) for r in reqs]
+    router.drain()
+    for r, rid in zip(reqs, rids):
+        st = router.poll(rid)
+        assert st["status"] == "done"
+        assert st["tokens"] == _offline(params, r), r
+    st = router.stats()
+    assert st["router_handoffs"] > 0
+    assert st["replica0_role"] == "prefill"
+    assert st["replica1_role"] == "decode"
+    # the transport actually carried KV: the decode replica loaded pages
+    assert router.schedulers[1].engine.counters["pages_loaded"] > 0
+    # pin-leak tripwire: every admission released its chain
+    assert router.schedulers[0].engine.prefix_stats()["pinned"] == 0
+
+
+def test_handoff_poll_surface(params, fleet):
+    """While the prefill job runs, poll() reports a request still in its
+    prefill phase (the job's plumbing statuses never leak)."""
+    router = fleet
+    rid = router.submit(Request(prompt=list(range(1, 30)), max_new=4))
+    assert router.poll(rid)["status"] in ("prefill",)
+    router.tick()
+    assert router.poll(rid)["status"] in ("prefill", "running", "done")
+    router.drain()
+    assert router.poll(rid)["status"] == "done"
+
+
+def test_short_cached_requests_skip_the_prefill_tier(params, fleet):
+    """Phase classification: sub-page prompts and fully stem-cached
+    prompts route straight to decode replicas — no handoff."""
+    router = fleet
+    # sub-page prompt: decode phase immediately
+    rid = router.submit(Request(prompt=[1, 2, 3], max_new=2))
+    assert router.replica_of(rid) == 1
+    router.drain()
+    # cache a stem via one long request...
+    stem = list(range(100, 100 + 24))
+    r0 = router.submit(Request(prompt=stem + [7], max_new=2))
+    router.drain()
+    assert router.poll(r0)["status"] == "done"
+    # ...now a stem-covered prompt is decode-phase (its full pages are
+    # all cached; only the sub-page tail prefills live)
+    rid2 = router.submit(Request(prompt=stem + [9], max_new=2))
+    assert router.replica_of(rid2) == 1
+    router.drain()
+    h = router.stats()["router_handoffs"]
+    assert h >= 1                       # the stem request handed off
+    assert router.poll(rid2)["status"] == "done"
+
+
+# ------------------------------------------------- starvation regression
+
+def _tick_ttfts(router, rids):
+    out = []
+    for rid in rids:
+        i, local = router._where[rid]
+        rec = router.schedulers[i]._recs[local]
+        assert rec.first_token_tick is not None
+        out.append(rec.first_token_tick - rec.submit_tick)
+    return out
+
+
+def _burst_worst_short_ticks(params, prefill_replicas: int) -> int:
+    """Run the burst scenario; return the worst SHORT request's TTFT in
+    per-replica ticks (each replica's own clock — the honest metric on a
+    single-process sim where all replicas share one wall thread)."""
+    router = _fleet(params, prefill=prefill_replicas) \
+        if prefill_replicas else \
+        Router.build(CFG, params, n_replicas=2, n_slots=2,
+                     max_len=MAX_LEN, prefill_chunk=5, kv_page_size=PAGE,
+                     prefix_pages=12, prefill_replicas=0, health=False,
+                     page_save_after=1)
+    rng = np.random.default_rng(5)
+    # warm the stem into the pool(s) so shorts are decode-phase; the
+    # shared fleet has PER-REPLICA pools — warm both (two simultaneous
+    # warms spread by the queue-depth tiebreak)
+    stem = rng.integers(0, CFG.vocab_size, 16).tolist()
+    warms = [router.submit(Request(prompt=stem + [i], max_new=1))
+             for i in range(1 if prefill_replicas else 2)]
+    router.drain()
+    for w in warms:
+        assert router.poll(w)["status"] == "done"
+    # THE BURST: long unique prompts (many admission chunks each),
+    # followed immediately by short stem-cached requests
+    longs = [router.submit(Request(
+        prompt=rng.integers(0, CFG.vocab_size, 48).tolist(), max_new=2))
+        for _ in range(6)]
+    shorts = [router.submit(Request(prompt=stem + [10 + i], max_new=2))
+              for i in range(4)]
+    router.drain()
+    for rid in longs + shorts:
+        assert router.poll(rid)["status"] == "done"
+    return max(_tick_ttfts(router, shorts))
+
+
+def test_long_prompt_burst_starvation_regression(params):
+    """The regression the phase router exists for: with disaggregation
+    on, short stem-cached requests arriving behind a burst of long
+    unique prompts no longer queue behind the burst's admissions — their
+    worst tick-TTFT collapses versus the shared fleet."""
+    shared = _burst_worst_short_ticks(params, 0)
+    disagg = _burst_worst_short_ticks(params, 1)
+    assert disagg * 2 <= shared, (
+        f"disaggregation did not protect short TTFT: {disagg} ticks "
+        f"vs {shared} on the shared fleet")
+
+
+# ----------------------------------------------------------------- chaos
+
+@pytest.mark.slow
+def test_prefill_replica_wedge_reroutes(params):
+    """Chaos: quarantine the dedicated prefill replica mid-burst — its
+    queued prompts re-route (the role falls back to the routable fleet),
+    every request completes with offline-identical tokens, and requeue
+    releases the page pins (the leak tripwire)."""
+    router = _fleet(params, n=3, prefill=1,
+                    health=HealthConfig(probation_delay_s=3600.0))
+    rng = np.random.default_rng(7)
+    reqs = [dict(prompt=rng.integers(0, CFG.vocab_size,
+                                     int(rng.integers(20, 40))).tolist(),
+                 max_new=3, seed=70 + i) for i in range(4)]
+    rids = [router.submit(Request(**r)) for r in reqs]
+    router.tick()                       # some prefill work starts
+    router.quarantine(0, "test wedge")  # the prefill replica dies
+    router.drain()
+    for r, rid in zip(reqs, rids):
+        st = router.poll(rid)
+        assert st["status"] == "done"
+        assert st["tokens"] == _offline(params, r), r
+    # decode replicas kept draining; pins all released
+    for s in router.schedulers:
+        assert s.engine.prefix_stats()["pinned"] == 0
+    assert router.stats()["router_quarantines"] == 1
+
+
+# ------------------------------------------------------------- validation
+
+def test_disagg_validation(params):
+    with pytest.raises(ValueError, match="page pool IS"):
+        Router.build(CFG, params, n_replicas=2, n_slots=2,
+                     max_len=MAX_LEN, prefill_chunk=5,
+                     prefill_replicas=1)
+    with pytest.raises(ValueError, match="at least one decode replica"):
+        Router.build(CFG, params, n_replicas=2, n_slots=2,
+                     max_len=MAX_LEN, prefill_chunk=5, kv_page_size=PAGE,
+                     prefix_pages=8, prefill_replicas=2)
+    # hand-built engines WITHOUT a shared store must be rejected — the
+    # Router checks before building schedulers, so stubs suffice
+    class _Stub:
+        n_slots = 2
+        page_store = None
+
+    with pytest.raises(ValueError, match="ONE shared page store"):
+        Router([_Stub(), _Stub()], prefill_replicas=1)
