@@ -143,6 +143,21 @@ class Checkpointer:
                                     params=ocp.args.StandardSave(params)),
             force=force)
 
+    def save_params(self, step: int, params: PyTree, *,
+                    force: bool = True) -> bool:
+        """Save a PARAMS-ONLY step: just the ``params`` item, no ``state``
+        twin — the weight-publish path (:mod:`dtf_tpu.publish`), where
+        ``step`` is a publish VERSION and the tree is weights by
+        definition. :meth:`restore_params` reads it back (``_has_item``
+        routes by the ``params`` subdir), and the guarded latest-step walk
+        covers these steps exactly like training checkpoints."""
+        step = int(step)
+        if step in self._mgr.all_steps():
+            return False
+        return self._mgr.save(
+            step, args=ocp.args.Composite(params=ocp.args.StandardSave(params)),
+            force=force)
+
     def save_durable(self, step: int, state: PyTree, *, retries: int = 2,
                      backoff_s: float = 0.25, sleep=None) -> bool:
         """Force-save ``step`` and block until durable, retrying transient

@@ -26,11 +26,12 @@ from dtf_tpu.fault.controller import (ControllerConfig, ControllerPolicy,
 from dtf_tpu.fault.elastic import (resume_state, survivor_host_count,
                                    survivor_mesh_shape)
 from dtf_tpu.fault.inject import (FaultHook, FaultPlan,
-                                  corrupt_latest_checkpoint, maybe_hook)
+                                  corrupt_latest_checkpoint,
+                                  corrupt_publish_version, maybe_hook)
 
 __all__ = [
     "ControllerConfig", "ControllerPolicy", "Decision", "HostObservation",
     "RunController", "read_heartbeat", "FaultHook", "FaultPlan",
-    "corrupt_latest_checkpoint", "maybe_hook", "resume_state",
-    "survivor_host_count", "survivor_mesh_shape",
+    "corrupt_latest_checkpoint", "corrupt_publish_version", "maybe_hook",
+    "resume_state", "survivor_host_count", "survivor_mesh_shape",
 ]

@@ -44,16 +44,23 @@ from typing import Mapping, Optional
 
 ENV_VAR = "DTF_FAULT_INJECT"
 
-KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash")
+KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash",
+         "crash_in_publish")
 
-#: the SERVE-tier verbs (ISSUE 12) — same env var, same grammar, but they
-#: target the serving pump instead of the training loop, so the trainer
-#: hook (`FaultPlan.from_env`) and the serve installer
+#: the SERVE-tier verbs (ISSUE 12/14) — same env var, same grammar, but
+#: they target the serving pump instead of the training loop, so the
+#: trainer hook (`FaultPlan.from_env`) and the serve installer
 #: (:func:`ServeFaultPlan.from_env` +
 #: :func:`dtf_tpu.serve.health.install_serve_fault`) each ignore the
-#: other family's kinds instead of erroring on them.
+#: other family's kinds instead of erroring on them. The hot-swap verbs:
+#: ``corrupt_publish@N`` damages the N-th NEW published version the
+#: swap watcher observes (0-based) before it loads — the digest check
+#: must skip it with a WARN and keep the fleet on its current version;
+#: ``wedge_in_swap@N:replica=k`` makes replica k's N-th ``swap_params``
+#: call (0-based) sleep then raise mid-swap — the Router must roll the
+#: partial fleet back onto ONE version.
 SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request",
-               "poison_draft")
+               "poison_draft", "corrupt_publish", "wedge_in_swap")
 
 
 class InjectedCrash(RuntimeError):
@@ -171,7 +178,7 @@ class FaultHook:
     WEDGE_POLL_S = 0.5
 
     def __init__(self, plan: FaultPlan, *, host_index: int = 0,
-                 checkpointer=None, emit=None):
+                 checkpointer=None, publisher=None, emit=None):
         self.plan = plan
         self.host_index = host_index
         self.ckpt = checkpointer
@@ -180,6 +187,9 @@ class FaultHook:
         if (plan.kind == "sigterm_in_save" and checkpointer is not None
                 and plan.applies_to(host_index)):
             self._wrap_save(checkpointer)
+        if (plan.kind == "crash_in_publish" and publisher is not None
+                and plan.applies_to(host_index)):
+            self._wrap_publish(publisher)
 
     def _note(self, what: str) -> None:
         try:
@@ -205,6 +215,24 @@ class FaultHook:
 
         ckpt.save = save
 
+    def _wrap_publish(self, publisher) -> None:
+        """Arm the ``crash_in_publish`` window: the publisher's
+        ``_pre_commit`` seam sits AFTER the version data is durable and
+        BEFORE the manifest rename — the crash must leave the previous
+        manifest (and version) fully servable (dtf_tpu/publish.py's
+        atomicity contract, proven by the swap chaos tests)."""
+        plan = self.plan
+
+        def pre_commit(version, step):
+            if not self.fired and step >= plan.step:
+                self.fired = True
+                self._note("crash_in_publish")
+                raise InjectedCrash(
+                    f"injected crash mid-publish of version {version} "
+                    f"(step {step}, host {self.host_index})")
+
+        publisher._pre_commit = pre_commit
+
     # ------------------------------------------------------- hook lifecycle
 
     def begin(self, state) -> None: ...
@@ -213,7 +241,8 @@ class FaultHook:
 
     def after_step(self, step: int, state, metrics) -> None:
         plan = self.plan
-        if (self.fired or plan.kind == "sigterm_in_save"
+        if (self.fired
+                or plan.kind in ("sigterm_in_save", "crash_in_publish")
                 or not plan.applies_to(self.host_index)
                 or step < plan.step):
             return
@@ -238,19 +267,58 @@ class FaultHook:
     def end(self, state) -> None: ...
 
 
-def maybe_hook(*, host_index: int = 0, checkpointer=None,
+def maybe_hook(*, host_index: int = 0, checkpointer=None, publisher=None,
                env: Optional[Mapping] = None) -> Optional[FaultHook]:
     """The launchers' one-liner: a FaultHook when ``DTF_FAULT_INJECT`` is
     set and targets this host, else None."""
     plan = FaultPlan.from_env(env)
     if plan is None or not plan.applies_to(host_index):
         return None
-    return FaultHook(plan, host_index=host_index, checkpointer=checkpointer)
+    return FaultHook(plan, host_index=host_index, checkpointer=checkpointer,
+                     publisher=publisher)
 
 
 # ---------------------------------------------------------------------------
 # Checkpoint corruption (the restore-fallback scenario).
 # ---------------------------------------------------------------------------
+
+def _corrupt_tree(root: str, mode: str, min_bytes: int) -> list[str]:
+    touched = []
+    for walk_root, _, files in os.walk(root):
+        for name in files:
+            path = os.path.join(walk_root, name)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size < min_bytes:
+                continue
+            if mode == "truncate":
+                with open(path, "r+b") as f:
+                    f.truncate(size // 2)
+            else:
+                with open(path, "r+b") as f:
+                    f.write(b"\xde\xad\xbe\xef" * 4)
+            touched.append(os.path.relpath(path, root))
+    return touched
+
+
+def corrupt_publish_version(publish_dir: str, version: int, *,
+                            mode: str = "garbage",
+                            min_bytes: int = 1) -> dict:
+    """Damage one PUBLISHED version's files (the ``corrupt_publish``
+    serve verb, ISSUE 14): the watcher's digest check must then skip the
+    version with a WARN and the fleet keeps serving what it has. Same
+    damage modes as :func:`corrupt_latest_checkpoint`."""
+    if mode not in ("truncate", "garbage"):
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    root = os.path.join(publish_dir, str(int(version)))
+    if not os.path.isdir(root):
+        raise FileNotFoundError(
+            f"no published version {version} under {publish_dir}")
+    return {"version": int(version),
+            "files": sorted(_corrupt_tree(root, mode, min_bytes))}
+
 
 def corrupt_latest_checkpoint(ckpt_dir: str, *, mode: str = "truncate",
                               min_bytes: int = 1) -> dict:
@@ -271,21 +339,7 @@ def corrupt_latest_checkpoint(ckpt_dir: str, *, mode: str = "truncate",
     if not steps:
         raise FileNotFoundError(f"no checkpoint steps under {ckpt_dir}")
     step = max(steps)
-    touched = []
-    for root, _, files in os.walk(os.path.join(ckpt_dir, str(step))):
-        for name in files:
-            path = os.path.join(root, name)
-            try:
-                size = os.path.getsize(path)
-            except OSError:
-                continue
-            if size < min_bytes:
-                continue
-            if mode == "truncate":
-                with open(path, "r+b") as f:
-                    f.truncate(size // 2)
-            else:
-                with open(path, "r+b") as f:
-                    f.write(b"\xde\xad\xbe\xef" * 4)
-            touched.append(os.path.relpath(path, ckpt_dir))
+    step_dir = os.path.join(ckpt_dir, str(step))
+    touched = [os.path.join(str(step), rel)
+               for rel in _corrupt_tree(step_dir, mode, min_bytes)]
     return {"step": step, "files": sorted(touched)}

@@ -11,6 +11,7 @@ nothing on the device timeline unless they block on results.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import signal
 import time
@@ -22,6 +23,8 @@ from dtf_tpu.checkpoint import Checkpointer
 from dtf_tpu.metrics import MetricWriter
 
 PyTree = Any
+
+log = logging.getLogger("dtf_tpu")
 
 
 class StopTraining(Exception):
@@ -154,6 +157,78 @@ class CheckpointHook(Hook):
     def end(self, state):
         self.ckpt.save(int(state.step), state, force=True)
         self.ckpt.wait()
+
+
+class PublishHook(Hook):
+    """Weight publishing for the train→serve hot-swap loop (ISSUE 14):
+    every ``every_n`` steps the current params subtree is published as
+    the next monotone version into the publish dir
+    (:class:`dtf_tpu.publish.ParamPublisher` — atomic manifest, content
+    digest; a crash mid-publish leaves the previous version intact).
+
+    Rides next to :class:`CheckpointHook`, not instead of it: a publish
+    is weights-only for serving replicas, the checkpoint stays the full
+    resume state. ``publisher=None`` is the non-chief fake-host idiom
+    (PreemptionHook's ``ckpt=None``): the hook is inert. The final
+    params are published at ``end()`` unless the last periodic publish
+    already covered that step. A publish failure WARNs and keeps
+    training — serving staleness must never take the trainer down."""
+
+    telemetry_bucket = "checkpoint"
+
+    def __init__(self, publisher, every_n: int = 100):
+        if every_n < 1:
+            raise ValueError(f"every_n={every_n} must be >= 1")
+        self.publisher = publisher
+        self.every_n = every_n
+        self._last_published_step: int | None = None
+
+    @staticmethod
+    def _params_of(state):
+        params = getattr(state, "params", None)
+        if params is None and isinstance(state, dict):
+            params = state.get("params")
+        if params is None:
+            raise ValueError(
+                "PublishHook needs a state with a params subtree "
+                "(TrainState attribute or dict key)")
+        return params
+
+    def _publish(self, step, state) -> None:
+        from dtf_tpu.fault.inject import InjectedCrash
+
+        try:
+            self.publisher.publish(step, self._params_of(state))
+            self._last_published_step = step
+        except InjectedCrash:
+            # the crash_in_publish chaos verb: the host DIES mid-publish
+            # (that is the scenario) — swallowing it here would turn the
+            # atomicity proof into a no-op, and end() must not re-publish
+            # from fit's finally (a SIGKILL'd host runs no end hooks;
+            # this in-process twin has to match it)
+            self.publisher = None
+            raise
+        except Exception as e:  # noqa: BLE001 — a failed publish leaves
+            # the previous version serving; training continues
+            log.warning(
+                "publish at step %d failed (%s: %.200s); the previous "
+                "published version keeps serving", step,
+                type(e).__name__, e)
+
+    def after_step(self, step, state, metrics):
+        if self.publisher is not None and step % self.every_n == 0:
+            self._publish(step, state)
+
+    def end(self, state):
+        if self.publisher is None:
+            return
+        step = getattr(state, "step", None)
+        if step is None and isinstance(state, dict):
+            step = state.get("step")      # dict states publish too —
+            #                               _params_of supports them
+        step = int(step) if step is not None else None
+        if step is not None and step != self._last_published_step:
+            self._publish(step, state)
 
 
 class PreemptionHook(Hook):

@@ -1,0 +1,316 @@
+"""Weight publishing — the train→serve hot-swap transport (ISSUE 14).
+
+ROADMAP item 4's scenario: a model that retrains continuously while
+serving heavy traffic. The trainer side emits params-only snapshots every
+K steps into a **publish dir**; the serving side polls it and rolls new
+versions across the fleet with zero downtime
+(:meth:`dtf_tpu.serve.router.Router.start_swap`). This module is the
+transport between them, built on three invariants:
+
+- **Atomic versioned manifest.** A publish is (1) an Orbax params-only
+  save under ``<dir>/<version>/params`` (``Checkpointer.save_params`` —
+  Orbax's own tmp+rename makes the step dir atomic), (2) a content digest
+  of the written files, (3) one ``PUBLISH_MANIFEST.json`` replacing the
+  previous via tmp + ``os.replace``. A crash ANYWHERE before step (3)
+  leaves the previous manifest — and therefore the previous version —
+  fully intact (the ``crash_in_publish`` chaos verb lands between (2) and
+  (3), the widest window, and tests/test_serve_swap.py proves the old
+  version still serves).
+- **Monotone versions.** Versions are a counter independent of the train
+  step (a retrain from step 0 still publishes version N+1); the manifest
+  records ``version -> {step, digest}`` history so readers can fall back
+  past a corrupt newest version with a WARN (the ``restore`` contract:
+  guarded walk for "latest", NO fallback for an explicitly requested
+  version).
+- **Content digest.** ``dir_digest`` hashes every file of the version dir
+  (name + bytes); :class:`PublishWatcher` verifies it before handing
+  params to a swap, so a truncated/garbled publish is SKIPPED with a WARN
+  and the fleet keeps serving the version it already has — corruption
+  never reaches a live replica.
+
+``PublishHook`` (:mod:`dtf_tpu.hooks`) drives :class:`ParamPublisher`
+from the training loop; :class:`PublishWatcher` is the serve-side poller
+(``scripts/serve_gpt.py --publish_dir`` wires it to the Router's rolling
+swap). docs/RESILIENCE.md §9 walks the end-to-end contract.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from typing import Any, Optional
+
+from dtf_tpu.checkpoint import Checkpointer
+
+PyTree = Any
+
+log = logging.getLogger("dtf_tpu")
+
+MANIFEST_BASENAME = "PUBLISH_MANIFEST.json"
+
+#: manifest history entries retained (>= the Checkpointer's max_to_keep,
+#: so every on-disk version has a recorded digest to verify against).
+HISTORY_KEEP = 8
+
+
+def dir_digest(path: str) -> str:
+    """Content digest of every regular file under ``path`` (sorted
+    relpath + raw bytes) — the publish integrity check. Chunked reads so
+    large param files never land in memory whole."""
+    h = hashlib.sha256()
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            fp = os.path.join(root, name)
+            h.update(os.path.relpath(fp, path).encode())
+            h.update(b"\0")
+            try:
+                with open(fp, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+            except OSError:
+                h.update(b"<unreadable>")
+            h.update(b"\0")
+    return "sha256:" + h.hexdigest()
+
+
+def read_manifest(directory: str) -> Optional[dict]:
+    """The publish manifest, or None (no publish yet / unreadable file —
+    callers WARN and fall back to the on-disk version walk)."""
+    path = os.path.join(os.fspath(directory), MANIFEST_BASENAME)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return None
+    except (OSError, json.JSONDecodeError, ValueError) as e:
+        log.warning("unreadable publish manifest %s (%s)", path, e)
+        return None
+
+
+class ParamPublisher:
+    """Trainer-side publisher: params-only snapshots + atomic manifest.
+
+    One per run, chief-process only for the manifest (the Orbax save is
+    collective — every process calls :meth:`publish`, each writes its own
+    shards, and only process 0 computes the digest and flips the
+    manifest). ``keep`` bounds on-disk versions (Orbax prunes; the
+    manifest history keeps digests for everything still on disk).
+    """
+
+    def __init__(self, directory: str, *, keep: int = 3):
+        self.directory = os.fspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckpt = Checkpointer(self.directory, max_to_keep=keep,
+                                  async_save=False)
+        m = read_manifest(self.directory)
+        on_disk = [int(d) for d in os.listdir(self.directory)
+                   if d.isdigit()]
+        # never REUSE a version number with a dir on disk: a crashed
+        # publish leaves an uncommitted dir whose bytes are the OLD
+        # attempt's — re-saving under the same number would no-op (Orbax
+        # dedupes existing steps) and the manifest would then commit a
+        # version whose content is not what was just published. Readers
+        # only trust manifest-committed versions, so the orphan dir is
+        # inert garbage Orbax's max_to_keep eventually prunes.
+        self._next_version = max(int(m["version"]) if m else 0,
+                                 max(on_disk, default=0)) + 1
+        #: test/chaos seam: called AFTER the version data is durable and
+        #: BEFORE the manifest flips — the widest crash window atomicity
+        #: has to cover (``crash_in_publish`` raises here; the previous
+        #: manifest must keep serving).
+        self._pre_commit = None
+        self.published = 0
+
+    @property
+    def checkpointer(self) -> Checkpointer:
+        return self._ckpt
+
+    def publish(self, step: int, params: PyTree) -> int:
+        """Publish ``params`` as the next version; returns the version.
+
+        Sequence (the atomicity contract, module docstring): durable
+        params-only save → digest → manifest tmp+rename. Any failure
+        before the rename leaves the previous version intact; the failed
+        attempt's dir (if any) is an UNCOMMITTED orphan readers never
+        trust — the next publish takes a fresh number (never reuses a
+        number with bytes on disk, see ``__init__``)."""
+        import jax
+
+        version = self._next_version
+        # consume the number NOW: a crash below must not let the next
+        # publish reuse a version whose dir may hold this attempt's bytes
+        self._next_version = version + 1
+        self._ckpt.save_params(version, params, force=True)
+        self._ckpt.wait()
+        if jax.process_index() != 0:
+            return version
+        digest = dir_digest(os.path.join(self.directory, str(version)))
+        if self._pre_commit is not None:
+            self._pre_commit(version, step)
+        old = read_manifest(self.directory) or {}
+        history = dict(old.get("history") or {})
+        history[str(version)] = {"step": int(step), "digest": digest}
+        for v in sorted(history, key=int)[:-HISTORY_KEEP]:
+            del history[v]
+        manifest = {"schema": 1, "version": version, "step": int(step),
+                    "digest": digest, "published_t": round(time.time(), 3),
+                    "history": history}
+        path = os.path.join(self.directory, MANIFEST_BASENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)       # THE commit point — atomic
+        self.published += 1
+        log.info("published params version %d (train step %d) to %s",
+                 version, step, self.directory)
+        return version
+
+    def close(self) -> None:
+        self._ckpt.close()
+
+
+def _known_digest(manifest: Optional[dict], version: int) -> Optional[str]:
+    if not manifest:
+        return None
+    if int(manifest.get("version", -1)) == version:
+        return manifest.get("digest")
+    return (manifest.get("history") or {}).get(str(version), {}).get("digest")
+
+
+def load_published(directory: str,
+                   version: Optional[int] = None) -> tuple[int, int, PyTree]:
+    """Restore published params: ``(version, train_step, params)``.
+
+    ``version=None`` is the guarded walk (``Checkpointer.restore``
+    parity): the manifest's newest version is verified against its digest
+    and restored; a corrupt/unreadable version WARNs and falls back to
+    the next older on-disk version, raising only when nothing is
+    servable. An EXPLICIT version gets no fallback — digest mismatch or
+    restore failure raises, because the caller asked for exactly that
+    version (the ``restore(step=...)`` contract, ISSUE 14 satellite)."""
+    directory = os.fspath(directory)
+    manifest = read_manifest(directory)
+    # closed before returning: a long-running swap watcher calls this per
+    # observed publish, and each Orbax manager owns threads/handles that
+    # would otherwise accumulate for the life of the server
+    ckpt = Checkpointer(directory)
+
+    def try_one(v: int, explicit: bool) -> tuple[int, int, PyTree]:
+        want = _known_digest(manifest, v)
+        if want is not None:
+            got = dir_digest(os.path.join(directory, str(v)))
+            if got != want:
+                raise ValueError(
+                    f"published version {v} at {directory} fails its "
+                    f"digest check ({got[:23]}... != {want[:23]}...) — "
+                    "corrupt publish")
+        elif explicit:
+            log.warning(
+                "published version %d at %s has no recorded digest "
+                "(manifest pruned/unreadable); restoring unverified", v,
+                directory)
+        params = ckpt.restore_params(step=v)
+        step = int((manifest or {}).get("history", {})
+                   .get(str(v), {}).get("step", -1))
+        if v == int((manifest or {}).get("version", -2)):
+            step = int(manifest["step"])
+        return v, step, params
+
+    try:
+        if version is not None:
+            return try_one(int(version), explicit=True)
+        on_disk = {int(d) for d in os.listdir(directory) if d.isdigit()}
+        if manifest:
+            # only manifest-COMMITTED versions are candidates: a dir the
+            # manifest never named is an uncommitted orphan (a crash between
+            # save and rename) whose bytes were never vouched for
+            known = {int(manifest["version"])} | \
+                {int(v) for v in (manifest.get("history") or {})}
+            versions = sorted(known & on_disk, reverse=True)
+        else:
+            versions = sorted(on_disk, reverse=True)
+            if versions:
+                log.warning(
+                    "no publish manifest under %s; walking %d on-disk "
+                    "version(s) UNVERIFIED", directory, len(versions))
+        if not versions:
+            raise FileNotFoundError(f"no published version under {directory}")
+        last_err: Optional[Exception] = None
+        for i, v in enumerate(versions):
+            try:
+                return try_one(v, explicit=False)
+            except Exception as e:  # noqa: BLE001 — any unreadable-version
+                # class falls back (the guarded-restore contract)
+                last_err = e
+                older = versions[i + 1] if i + 1 < len(versions) else None
+                log.warning(
+                    "published version %d at %s is unservable (%s: %.200s); "
+                    "falling back to %s", v, directory, type(e).__name__, e,
+                    f"version {older}" if older is not None
+                    else "nothing — no older version")
+        raise RuntimeError(
+            f"every published version under {directory} is unservable "
+            f"(tried {versions}); last error: "
+            f"{type(last_err).__name__}: {last_err}")
+    finally:
+        ckpt.close()
+
+
+class PublishWatcher:
+    """Serve-side poller over a publish dir (module docstring).
+
+    :meth:`load_new` is the swap driver's one call: None when there is
+    nothing new, else ``(version, step, params)`` for a version newer
+    than the last applied — digest-verified, with corrupt publishes
+    SKIPPED once with a WARN (the fleet keeps serving what it has; the
+    version is remembered so a wedged publish cannot re-WARN every
+    poll). Mark :meth:`note_applied` after the rolling swap completes so
+    a rolled-back version can be retried by a later republish only.
+    """
+
+    def __init__(self, directory: str, *, applied_version: int = 0):
+        self.directory = os.fspath(directory)
+        self.applied_version = applied_version
+        self.skipped: set[int] = set()
+
+    def manifest(self) -> Optional[dict]:
+        return read_manifest(self.directory)
+
+    def poll(self) -> Optional[dict]:
+        """The manifest, iff it names a version newer than the last
+        applied and not already skipped as corrupt."""
+        m = self.manifest()
+        if not m:
+            return None
+        v = int(m.get("version", 0))
+        if v <= self.applied_version or v in self.skipped:
+            return None
+        return m
+
+    def load_new(self) -> Optional[tuple[int, int, PyTree]]:
+        m = self.poll()
+        if m is None:
+            return None
+        v = int(m["version"])
+        try:
+            return load_published(self.directory, version=v)
+        except Exception as e:  # noqa: BLE001 — a corrupt publish must
+            # not take serving down: skip it, keep the current version
+            self.skipped.add(v)
+            log.warning(
+                "skipping published version %d at %s (%s: %.200s); the "
+                "fleet keeps serving its current version", v,
+                self.directory, type(e).__name__, e)
+            return None
+
+    def note_applied(self, version: int) -> None:
+        self.applied_version = max(self.applied_version, int(version))
+
+
+__all__ = ["MANIFEST_BASENAME", "ParamPublisher", "PublishWatcher",
+           "dir_digest", "load_published", "read_manifest"]

@@ -38,8 +38,16 @@ from millions of users") actually asks for. Five layers:
   poison_request``). Pairs with per-request deadlines, bounded-queue
   load shedding and quarantine requeue in scheduler/router.
 
+Above the router sits the zero-downtime WEIGHT HOT-SWAP (ISSUE 14):
+``Router.start_swap`` rolls newly published param versions
+(:mod:`dtf_tpu.publish` — atomic versioned manifests) across the fleet
+one drained replica at a time with a health-gated canary and automatic
+fleet-wide rollback; completed records are stamped with the param
+version that decoded them and prefix pages are version-epoch'd so
+cached KV never crosses a swap.
+
 docs/SERVING.md walks the architecture and the fixed-shape rules;
-docs/RESILIENCE.md "Serving" walks the failure semantics.
+docs/RESILIENCE.md "Serving" + §9 walk the failure semantics.
 """
 
 from dtf_tpu.serve.client import (Heartbeat, PoissonLoadGen, ServeClient,
@@ -48,11 +56,12 @@ from dtf_tpu.serve.engine import DecodeEngine, decode_step_view
 from dtf_tpu.serve.health import (HealthConfig, HealthTracker,
                                   install_serve_fault)
 from dtf_tpu.serve.pages import PageStore, PrefixIndex
-from dtf_tpu.serve.router import Router
+from dtf_tpu.serve.router import Router, SwapConfig
 from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
                                      RequestFailed, Scheduler)
 
 __all__ = ["DecodeEngine", "FAILED_STATUSES", "Heartbeat", "HealthConfig",
            "HealthTracker", "PageStore", "PoissonLoadGen", "PrefixIndex",
            "Request", "RequestFailed", "Router", "Scheduler", "ServeClient",
-           "decode_step_view", "install_serve_fault", "replay"]
+           "SwapConfig", "decode_step_view", "install_serve_fault",
+           "replay"]

@@ -63,7 +63,9 @@ _HEARTBEAT_KEYS = ("serve_completed", "serve_queue_depth",
                    "router_occupancy", "router_ttft_p50_s",
                    "router_ttft_p99_s", "router_ttft_slo_ok_frac",
                    "router_shed", "router_timeouts", "router_requeued",
-                   "router_quarantines")
+                   "router_quarantines", "router_version",
+                   "router_swaps", "router_swap_rollbacks",
+                   "router_swap_in_progress")
 
 
 class Heartbeat:
@@ -149,11 +151,19 @@ class Heartbeat:
         if self.flight is not None:
             # the run-controller liveness surface: the heartbeat file a
             # chief-side watcher polls, with the serve panel riding along
-            self.flight.write_heartbeat(extra={"serve": {
-                k: snap[k] for k in
-                ("serve_completed", "serve_queue_depth", "router_completed",
-                 "router_queue_depth", "router_quarantines")
-                if k in snap}})
+            serve = {k: snap[k] for k in
+                     ("serve_completed", "serve_queue_depth",
+                      "router_completed", "router_queue_depth",
+                      "router_quarantines", "router_version",
+                      "router_swaps", "router_swap_rollbacks")
+                     if k in snap}
+            # per-replica ACTIVE param versions: the flight-recorder
+            # serve panel's skew view (ISSUE 14 satellite)
+            versions = {k: snap[k] for k in snap
+                        if k.startswith("replica") and k.endswith("_version")}
+            if versions:
+                serve["replica_versions"] = versions
+            self.flight.write_heartbeat(extra={"serve": serve})
         return snap
 
     def stats(self) -> dict:

@@ -559,7 +559,14 @@ class DecodeEngine:
         self.counters = {"prefill_chunks": 0, "decode_steps": 0,
                          "pages_loaded": 0, "pages_saved": 0,
                          "prefix_hit_tokens": 0, "prefix_miss_tokens": 0,
-                         "probe_decodes": 0}
+                         "probe_decodes": 0, "param_swaps": 0}
+        #: the param VERSION this engine serves (ISSUE 14 hot-swap):
+        #: monotone, bumped by :meth:`swap_params`, stamped into every
+        #: completed record by the scheduler and used as the prefix-page
+        #: EPOCH so a cached stem can never serve stale-weight KV. 0 is
+        #: "as constructed"; launchers serving a published version stamp
+        #: it via :meth:`set_param_version` before traffic.
+        self.param_version = 0
         if self.spec_k:
             # acceptance/fallback accounting: proposed counts k per LIVE
             # verified row per tick, accepted counts the matched prefix
@@ -1017,17 +1024,114 @@ class DecodeEngine:
         self.decode()
         self.counters["probe_decodes"] += 1
 
+    # -------------------------------------------------- weight hot-swap
+
+    @staticmethod
+    def _check_tree_like(new, old, what: str) -> None:
+        """New weights must be drop-in for the compiled executables:
+        same tree, same shapes, same dtypes — anything else would need a
+        recompile, which hot-swap exists to avoid. Fails loudly naming
+        the first offending leaf."""
+        nf, ntd = jax.tree_util.tree_flatten_with_path(new)
+        of, otd = jax.tree_util.tree_flatten_with_path(old)
+        if ntd != otd:
+            raise ValueError(
+                f"swap_params: new {what} tree structure differs from "
+                "the served tree — hot-swap needs the SAME architecture "
+                "(a different config is a new engine, not a swap)")
+        for (pn, n), (_, o) in zip(nf, of):
+            if (tuple(n.shape) != tuple(o.shape)
+                    or np.dtype(n.dtype) != np.dtype(o.dtype)):
+                raise ValueError(
+                    f"swap_params: {what} leaf "
+                    f"{jax.tree_util.keystr(pn)} is {tuple(n.shape)}/"
+                    f"{np.dtype(n.dtype)}, the served engine expects "
+                    f"{tuple(o.shape)}/{np.dtype(o.dtype)}")
+
+    def set_param_version(self, version: int) -> None:
+        """Stamp the version of the weights this engine was BUILT with
+        (serving a published version from startup) — no swap, no
+        counters; call before any traffic so record stamps and page
+        epochs carry the real version instead of 0."""
+        self.param_version = int(version)
+
+    def swap_params(self, params: PyTree, *, draft_params: PyTree = None,
+                    version: Optional[int] = None) -> int:
+        """Hot-swap the served weights in place — ZERO recompiles.
+
+        The new tree is validated against the served one (same
+        structure/shapes/dtypes, :meth:`_check_tree_like`) and re-placed
+        onto the OLD leaves' shardings (``jax.device_put`` per leaf —
+        single device and TP mesh alike), so the AOT executables accept
+        the new arrays exactly like the old ones: ``trace_counts`` stays
+        pinned (counter-tested in tests/test_serve_swap.py).
+
+        Caller contract (the Router's rolling swap enforces it): the
+        engine must be DRAINED — no queued/admitting/running request —
+        when this runs; an in-flight stream would otherwise mix logits
+        of two versions. Stale slot state needs no cleanup (the PR 4
+        reset contract: an admitted request fully reinitializes its
+        slot), and the prefix-page EPOCH bump makes every page the old
+        weights produced unreachable from this engine.
+
+        For a SPEC engine the draft rides the same transaction:
+        ``draft_params`` swaps it explicitly; under SELF-speculation the
+        new target tree is the draft by definition; a distinct draft
+        with no new weights keeps proposing from the old ones — still
+        correct (the verifier samples every delivered token; proposals
+        only set the acceptance rate), just logged.
+
+        ``version`` stamps :attr:`param_version` (the publish version);
+        default is the previous version + 1. Returns the new version."""
+        self._check_tree_like(params, self._params, "params")
+        # re-place onto the OLD leaves' shardings: the committed layout
+        # the AOT executables were compiled against, whatever devices/
+        # mesh that is — a host array, a differently-placed array or a
+        # resharded tree all land right
+        placed = jax.tree.map(
+            lambda n, o: jax.device_put(n, o.sharding),
+            params, self._params)
+        placed_draft = None
+        if self.spec_k:
+            if draft_params is not None:
+                self._check_tree_like(draft_params, self._draft_params,
+                                      "draft_params")
+                placed_draft = jax.tree.map(
+                    lambda n, o: jax.device_put(n, o.sharding),
+                    draft_params, self._draft_params)
+            elif self._draft_self:
+                # self-speculation: draft ≡ target architecture AND
+                # weights — the one placed tree swaps both sides
+                placed_draft = placed
+            else:
+                log.info(
+                    "swap_params: spec engine keeps its previous draft "
+                    "weights (no draft_params passed for a distinct "
+                    "draft model) — acceptance may drop, correctness "
+                    "cannot (the verifier owns the token stream)")
+        # THE transaction: target, draft and version flip together,
+        # between compiled dispatches (the pump loop is single-threaded)
+        self._params = placed
+        if placed_draft is not None:
+            self._draft_params = placed_draft
+        self.param_version = (int(version) if version is not None
+                              else self.param_version + 1)
+        self.counters["param_swaps"] += 1
+        return self.param_version
+
     # ----------------------------------------------------- prefix page API
 
     def prefix_match(self, prompt: Sequence[int]):
         """Admission-time lookup: the longest cached page chain exactly
-        matching a prefix of ``prompt``, PINNED until
-        :meth:`release_prefix` (the scheduler releases on slot evict).
-        None on a miss or with the page cache off."""
+        matching a prefix of ``prompt`` AT THIS ENGINE's param version
+        (pages are epoch-keyed — KV from other weight versions is
+        unreachable), PINNED until :meth:`release_prefix` (the scheduler
+        releases on slot evict). None on a miss or with the page cache
+        off."""
         if self._prefix is None:
             return None
         prompt = tuple(int(t) for t in prompt)
-        h = self._prefix.acquire(prompt)
+        h = self._prefix.acquire(prompt, epoch=self.param_version)
         if h is None:
             self.counters["prefix_miss_tokens"] += len(prompt)
         else:
@@ -1071,16 +1175,18 @@ class DecodeEngine:
         if self._prefix is None:
             return
         prompt = tuple(int(t) for t in prompt)
+        epoch = self.param_version
         full = len(prompt) // self.page_size
-        have, parent = self._prefix.longest(prompt, cap=full)
+        have, parent = self._prefix.longest(prompt, cap=full, epoch=epoch)
         # save admission: only prefixes traffic has repeated are worth a
         # dispatch — a unique tail page would cost host overhead and a
         # pool slot for KV nobody will ever hit (pages.py docstring)
-        full = have + self._prefix.save_eligible(prompt, have, full)
+        full = have + self._prefix.save_eligible(prompt, have, full,
+                                                 epoch=epoch)
         ids = []
         for i in range(have, full):
             ent = self._prefix.reserve(prompt[:(i + 1) * self.page_size],
-                                       parent)
+                                       parent, epoch=epoch)
             if ent is None:
                 break
             ids.append(ent.page_id)

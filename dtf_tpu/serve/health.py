@@ -52,7 +52,8 @@ import os
 import time
 from typing import Optional
 
-from dtf_tpu.fault.inject import InjectedPoison, ServeFaultPlan
+from dtf_tpu.fault.inject import (InjectedCrash, InjectedPoison,
+                                  ServeFaultPlan, corrupt_publish_version)
 from dtf_tpu.metrics import quantile
 
 log = logging.getLogger("dtf_tpu")
@@ -279,7 +280,7 @@ class ServeFaultState:
 def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
                         wedge_s: Optional[float] = None,
                         slow_s: Optional[float] = None,
-                        emit=None) -> ServeFaultState:
+                        watcher=None, emit=None) -> ServeFaultState:
     """Arm a serve fault on a live Router or Scheduler (``pump``).
 
     - ``wedge_replica@N[:replica=k]`` — from the target engine's N-th
@@ -299,6 +300,15 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
       (verify with null proposals — ``draft_fallbacks`` counts) instead
       of erroring the request or the replica: speculation is an
       optimization, never a correctness dependency.
+    - ``wedge_in_swap@N[:replica=k]`` — the targeted replica's N-th
+      ``swap_params`` call (0-based) sleeps ``wedge_s`` then raises
+      mid-rolling-swap. The Router must roll the partial fleet back onto
+      ONE version (docs/RESILIENCE.md §9); fires once.
+    - ``corrupt_publish@N`` — needs ``watcher`` (a
+      :class:`dtf_tpu.publish.PublishWatcher`): the N-th NEW published
+      version the watcher observes (0-based) is damaged on disk before
+      it loads. The digest check must skip it with a WARN and the fleet
+      keeps serving its current version.
 
     Ticks are counted in the TARGET's own call domain (decode calls /
     submits) so plans stay deterministic under Poisson timing. ``sleep``
@@ -381,6 +391,57 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
                 return _orig(**kw)
 
             eng.draft_propose = draft
+        return state
+
+    if plan.kind == "wedge_in_swap":
+        delay = (wedge_s if wedge_s is not None
+                 else float(os.environ.get("DTF_FAULT_WEDGE_S", "0.75")))
+        for k, s in enumerate(scheds):
+            if plan.replica is not None and plan.replica != k:
+                continue
+            eng = s.engine
+            orig = getattr(eng, "swap_params", None)
+            if orig is None:
+                continue        # fakes without a swap surface: no-op
+            calls = [0]
+
+            def swap(*a, _orig=orig, _calls=calls, _k=k, **kw):
+                idx = _calls[0]
+                _calls[0] += 1
+                if idx == plan.tick and not state.fired:
+                    state.fired = True
+                    note("firing", on_replica=_k, delay_s=delay)
+                    sleep(delay)
+                    raise InjectedCrash(
+                        f"injected wedge_in_swap on replica {_k} "
+                        f"(swap call #{idx})")
+                return _orig(*a, **kw)
+
+            eng.swap_params = swap
+        return state
+
+    if plan.kind == "corrupt_publish":
+        if watcher is None:
+            return state        # nothing to arm without a publish watcher
+        orig_load = watcher.load_new
+        seen: list = []
+
+        def load_new(*, _orig=orig_load):
+            m = watcher.poll()
+            if m is not None:
+                v = int(m["version"])
+                if v not in seen:
+                    seen.append(v)
+                    if len(seen) - 1 == plan.tick and not state.fired:
+                        state.fired = True
+                        note("firing", version=v)
+                        try:
+                            corrupt_publish_version(watcher.directory, v)
+                        except FileNotFoundError:
+                            pass   # raced a prune; nothing to corrupt
+            return _orig()
+
+        watcher.load_new = load_new
         return state
 
     delay = (wedge_s if wedge_s is not None

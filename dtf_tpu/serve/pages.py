@@ -139,13 +139,18 @@ def check_pool_compatible(pool, pool_abs) -> None:
 @dataclasses.dataclass
 class _Entry:
     """One cached page: ``tokens`` is the WHOLE prefix through this page
-    (exact-match verification), ``refs`` counts children + live pins."""
+    (exact-match verification), ``refs`` counts children + live pins,
+    ``epoch`` is the param VERSION whose weights produced the KV — a
+    lookup only matches entries of the requesting engine's own version
+    (ISSUE 14: a cached stem can never serve stale-weight KV across a
+    hot-swap)."""
 
     page_id: int
     tokens: tuple
     parent: Optional["_Entry"]
     refs: int = 0
     last_use: int = 0
+    epoch: int = 0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -197,33 +202,40 @@ class PrefixIndex:
 
     # ------------------------------------------------------------- lookup
 
-    def _find(self, tokens: tuple) -> Optional[_Entry]:
+    def _find(self, tokens: tuple, epoch: int = 0) -> Optional[_Entry]:
         for e in self._by_hash.get(self._hash(tokens), ()):
-            if e.tokens == tokens:        # exact-match verification
+            # exact-match verification + the param-version epoch gate: KV
+            # produced by different weights is a different cache entry
+            # even for identical tokens (hot-swap invariant, ISSUE 14)
+            if e.tokens == tokens and e.epoch == epoch:
                 return e
         return None
 
     def longest(self, prompt: Sequence[int],
-                cap: Optional[int] = None) -> tuple[int, Optional[_Entry]]:
-        """Longest registered page chain covering a prefix of ``prompt``:
-        ``(n_pages, deepest entry)``. ``cap`` bounds the page count (the
-        engine caps admission reuse at ``(len-1)//page`` so at least one
-        prompt token always runs live — the first sampled token needs the
-        last position's logits)."""
+                cap: Optional[int] = None, *,
+                epoch: int = 0) -> tuple[int, Optional[_Entry]]:
+        """Longest registered page chain covering a prefix of ``prompt``
+        AT ``epoch`` (the caller's param version): ``(n_pages, deepest
+        entry)``. ``cap`` bounds the page count (the engine caps
+        admission reuse at ``(len-1)//page`` so at least one prompt token
+        always runs live — the first sampled token needs the last
+        position's logits)."""
         p = self.page_size
         top = len(prompt) // p if cap is None else cap
         for k in range(top, 0, -1):
-            e = self._find(tuple(prompt[:k * p]))
+            e = self._find(tuple(prompt[:k * p]), epoch)
             if e is not None:
                 return k, e
         return 0, None
 
-    def acquire(self, prompt: Sequence[int]) -> Optional[PrefixHandle]:
-        """Pin the longest reusable chain for ``prompt`` (admission-time
-        lookup). None on a miss; on a hit the DEEPEST entry takes one pin
-        (its ancestors are already held alive by child refs)."""
+    def acquire(self, prompt: Sequence[int], *,
+                epoch: int = 0) -> Optional[PrefixHandle]:
+        """Pin the longest reusable chain for ``prompt`` at ``epoch``
+        (admission-time lookup). None on a miss; on a hit the DEEPEST
+        entry takes one pin (its ancestors are already held alive by
+        child refs)."""
         cap = max(0, (len(prompt) - 1) // self.page_size)
-        k, e = self.longest(prompt, cap=cap)
+        k, e = self.longest(prompt, cap=cap, epoch=epoch)
         if e is None:
             self.stats["misses"] += 1
             return None
@@ -249,7 +261,7 @@ class PrefixIndex:
     # ------------------------------------------------------------ reserve
 
     def save_eligible(self, prompt: Sequence[int], have: int,
-                      full: int) -> int:
+                      full: int, *, epoch: int = 0) -> int:
         """The save-admission filter: bump the sighting count of every
         not-yet-cached full-page prefix of ``prompt`` (pages ``have`` to
         ``full``) and return how many CONTIGUOUS pages from ``have`` have
@@ -261,7 +273,9 @@ class PrefixIndex:
         p = self.page_size
         eligible, counting = 0, True
         for i in range(have, full):
-            prefix = tuple(prompt[:(i + 1) * p])
+            # sightings are per (epoch, prefix): pre-swap traffic must
+            # not pre-qualify a prefix for the NEW version's save gate
+            prefix = (epoch, tuple(prompt[:(i + 1) * p]))
             c = self._seen.pop(prefix, 0) + 1
             self._seen[prefix] = c               # re-insert = LRU refresh
             while len(self._seen) > self._seen_cap:
@@ -272,20 +286,26 @@ class PrefixIndex:
                 counting = False
         return eligible
 
-    def reserve(self, prefix: tuple,
-                parent: Optional[_Entry]) -> Optional[_Entry]:
-        """Allocate a page for ``prefix`` (registering it immediately) —
-        from the free list, else by evicting the LRU unpinned childless
-        entry. None when every page is pinned or parented (the save is
-        skipped, never blocked). ``parent`` must be the entry for
-        ``prefix`` minus one page (None for the first page)."""
+    def reserve(self, prefix: tuple, parent: Optional[_Entry], *,
+                epoch: int = 0) -> Optional[_Entry]:
+        """Allocate a page for ``prefix`` at ``epoch`` (registering it
+        immediately) — from the free list, else by evicting the LRU
+        unpinned childless entry. None when every page is pinned or
+        parented (the save is skipped, never blocked). ``parent`` must be
+        the entry for ``prefix`` minus one page (None for the first
+        page) and of the SAME epoch — a chain can never cross a weight
+        version."""
         if len(prefix) != (0 if parent is None
                            else len(parent.tokens)) + self.page_size:
             raise ValueError(
                 f"prefix of {len(prefix)} tokens does not extend parent "
                 f"({0 if parent is None else len(parent.tokens)}) by one "
                 f"{self.page_size}-token page")
-        if self._find(prefix) is not None:
+        if parent is not None and parent.epoch != epoch:
+            raise ValueError(
+                f"parent epoch {parent.epoch} != {epoch}: a page chain "
+                "cannot mix KV from two param versions")
+        if self._find(prefix, epoch) is not None:
             raise ValueError("prefix already registered; look it up "
                              "instead of reserving a duplicate page")
         if self._free:
@@ -306,12 +326,34 @@ class PrefixIndex:
             self._evict(victim)
             pid = self._free.pop()
         self._clock += 1
-        e = _Entry(pid, prefix, parent, refs=0, last_use=self._clock)
+        e = _Entry(pid, prefix, parent, refs=0, last_use=self._clock,
+                   epoch=epoch)
         if parent is not None:
             parent.refs += 1
         self._by_hash.setdefault(self._hash(prefix), []).append(e)
-        self._seen.pop(prefix, None)     # cached now — sightings done
+        self._seen.pop((epoch, prefix), None)  # cached now — sightings done
         return e
+
+    def invalidate_stale(self, epoch: int) -> int:
+        """Free every entry whose ``epoch`` differs from the (new)
+        current one — the post-swap cleanup. Lookups already epoch-gate
+        (stale KV is unreachable the moment a replica's version bumps —
+        the LAZY half of invalidation); this reclaims the pool bytes
+        eagerly once a rolling swap completes. Runs leaf-first until a
+        fixpoint (evicting a child unparents its ancestor); entries
+        still pinned are left for their release + LRU (the drain path
+        releases pins BEFORE the swap, so post-swap this returns with 0
+        stale entries left — ``prefix_stats()['pinned']`` is the
+        tripwire). Returns the number of pages freed."""
+        freed = 0
+        while True:
+            stale = [e for es in self._by_hash.values() for e in es
+                     if e.epoch != epoch and e.refs == 0]
+            if not stale:
+                return freed
+            for e in stale:
+                self._evict(e)
+                freed += 1
 
     def _evict(self, e: _Entry) -> None:
         es = self._by_hash[self._hash(e.tokens)]

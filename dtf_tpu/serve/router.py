@@ -38,11 +38,27 @@ fault-free run of the same request. When NO replica is routable the
 router sheds at the front door with a ``retry_after_s`` derived from the
 earliest probation ETA. docs/RESILIENCE.md "Serving" walks the states
 and the chaos matrix that pins the behavior.
+
+Weight hot-swap (ISSUE 14): :meth:`Router.start_swap` rolls a new param
+version across the fleet with ZERO downtime — one replica at a time is
+drained through the same requeue path, swapped in place
+(``DecodeEngine.swap_params``: no recompiles, ``trace_counts`` pinned),
+probed and re-admitted; the first swapped replica serves a
+:class:`SwapConfig`-sized CANARY window under the health watchdog and a
+TTFT-SLO gate, and a breach (or any swap-step failure — the
+``wedge_in_swap`` chaos verb) rolls every swapped replica back onto the
+previous version fleet-wide. ``maybe_swap_published`` drives it from a
+:class:`dtf_tpu.publish.PublishWatcher`. Completed records stamp the
+param version that decoded them; docs/RESILIENCE.md §9 walks the
+contracts.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
+import itertools
+import logging
 import time
 from typing import Optional, Sequence
 
@@ -52,12 +68,45 @@ from dtf_tpu.serve.engine import DecodeEngine
 from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
                                      RequestFailed, Scheduler)
 
+log = logging.getLogger("dtf_tpu")
+
 #: per-replica stat keys surfaced as ``replica{i}_<key>`` (the SLO panel);
 #: everything else stays per-scheduler to keep the JSON line bounded.
 _REPLICA_KEYS = ("serve_completed", "serve_occupancy_mean",
                  "serve_ttft_p50_s", "serve_ttft_p99_s",
                  "serve_queue_peak", "serve_ttft_slo_ok_frac",
                  "serve_shed", "serve_timeouts", "serve_requeued_in")
+
+
+@dataclasses.dataclass(frozen=True)
+class SwapConfig:
+    """Knobs of the rolling weight swap (ISSUE 14, module docstring).
+
+    The FIRST swapped replica is the **canary**: for ``canary_ticks``
+    router ticks it serves live traffic on the new version alone, and a
+    breach inside that window — the canary's health state leaving
+    HEALTHY (the watchdog's slow/wedge/fault verdicts), or, with a TTFT
+    SLO configured, its post-swap ok-fraction dropping under
+    ``slo_floor`` over at least ``slo_min_samples`` completions —
+    triggers an automatic FLEET-WIDE rollback to the previous version.
+    Only after a clean window does the swap roll across the rest of the
+    fleet, one replica per tick."""
+
+    canary_ticks: int = 8
+    slo_floor: float = 0.0          # 0 = health-gate only
+    slo_min_samples: int = 1
+
+    def __post_init__(self):
+        if self.canary_ticks < 1:
+            raise ValueError(
+                f"canary_ticks={self.canary_ticks} must be >= 1 (a swap "
+                "with no canary window cannot be health-gated)")
+        if not 0.0 <= self.slo_floor <= 1.0:
+            raise ValueError(f"slo_floor={self.slo_floor} must be in "
+                             "[0, 1]")
+        if self.slo_min_samples < 1:
+            raise ValueError(
+                f"slo_min_samples={self.slo_min_samples} must be >= 1")
 
 
 class Router:
@@ -143,6 +192,31 @@ class Router:
         self._handoff: dict[int, tuple[Request, float]] = {}
         self._handoffs = 0
         self._next_id = 0
+        # ---- rolling weight swap (ISSUE 14) -------------------------
+        #: the fleet's COMMITTED param version (what a fully-converged
+        #: fleet serves); per-replica truth lives on each engine.
+        self._version = 0
+        #: in-progress swap state machine (None = steady state)
+        self._swap: Optional[dict] = None
+        #: replica currently being drained+swapped (never routable)
+        self._swapping: Optional[int] = None
+        #: replicas stuck on weights the fleet REJECTED (their reverse
+        #: swap failed during a rollback): version -> repair payload.
+        #: Such a replica is never routable — probation would otherwise
+        #: re-admit it serving a rolled-back version — until
+        #: :meth:`_retry_version_repair` aligns it with the fleet.
+        self._version_repair: dict[int, tuple] = {}
+        #: health-less fleets have no quarantine backoff to pace repair
+        #: retries: (next_allowed_tick, delay_ticks) per pending repair
+        self._repair_backoff: dict[int, tuple[int, int]] = {}
+        self._ticks = 0
+        self._swaps = 0
+        self._swap_rollbacks = 0
+        self._last_swap: Optional[dict] = None
+        #: version-skew tripwire: WARN once when the fleet spans more
+        #: than one version OUTSIDE an in-progress swap, re-armed when
+        #: the fleet converges again (ISSUE 14 satellite)
+        self._skew_warned = False
 
     @classmethod
     def build(cls, cfg, params, *, n_replicas: int, n_slots: int,
@@ -192,6 +266,12 @@ class Router:
     # ------------------------------------------------------------ admission
 
     def _routable(self, i: int) -> bool:
+        if i == self._swapping:     # mid-drain/swap: not a candidate
+            return False
+        if i in self._version_repair:
+            # holding weights the fleet rolled back from: traffic (and
+            # probation probes) must wait for the version repair
+            return False
         return self.health is None or self.health.routable(i)
 
     def _pick(self, phase: str = "decode") -> Optional[int]:
@@ -225,12 +305,27 @@ class Router:
         admission is one page gather + a tail chunk)."""
         if not self._prefill_replicas:
             return False
+        # pages are EPOCH-keyed (ISSUE 14): while ROUTABLE replicas'
+        # versions diverge (a rolling swap in flight), a prefill job
+        # would save pages at one version that the decode admission
+        # gathers at another — a guaranteed miss that burns prefill-tier
+        # work AND a promote hop. Route straight to decode (full prefill
+        # there: the same tokens, one fewer hop) until they converge —
+        # the window is bounded by the roll. Non-routable replicas
+        # (quarantined / awaiting version repair) carry no traffic, so
+        # their stray version must not disable disaggregation.
+        versions = {getattr(s.engine, "param_version", 0)
+                    for i, s in enumerate(self.schedulers)
+                    if self._routable(i)}
+        if len(versions) > 1:
+            return False
         eng = self.schedulers[0].engine
         prompt = tuple(int(t) for t in req.prompt)
         full = max(0, (len(prompt) - 1) // eng.page_size)
         if full < 1:
             return False
-        have, _ = eng._prefix.longest(prompt, cap=full)
+        have, _ = eng._prefix.longest(
+            prompt, cap=full, epoch=getattr(eng, "param_version", 0))
         return have < full
 
     def _shed_at_door(self, rid: int) -> None:
@@ -322,7 +417,18 @@ class Router:
         out = {f"replica{i}": s.postmortem_state()
                for i, s in enumerate(self.schedulers)}
         out["router"] = {"shed_at_door": self._shed_router,
-                         "requeued": self._requeued}
+                         "requeued": self._requeued,
+                         "version": self._version,
+                         "replica_versions": [
+                             getattr(s.engine, "param_version", None)
+                             for s in self.schedulers],
+                         "swaps": self._swaps,
+                         "swap_rollbacks": self._swap_rollbacks,
+                         "swap_in_progress": self._swap is not None,
+                         "version_repair_pending": sorted(
+                             self._version_repair)}
+        if self._last_swap is not None:
+            out["router"]["last_swap"] = dict(self._last_swap)
         if self.health is not None:
             out["router"]["health"] = self.health.states()
             out["router"]["health_counters"] = dict(self.health.counters)
@@ -344,13 +450,14 @@ class Router:
         self._requeue_from(i)
 
     def _requeue_from(self, i: int) -> None:
-        """Drain quarantined replica ``i``: every in-flight request is
-        re-submitted to a survivor in submit order with its ORIGINAL
-        fleet rid, trace id and submit time — the survivor re-prefills
-        (cached stems in one page gather where its prefix pool has them)
-        and regenerates the deterministic token stream, so completed
-        tokens are bitwise identical to a fault-free run. With no
-        routable survivor the request sheds at the front door."""
+        """Drain replica ``i`` (quarantined, or mid-swap): every
+        in-flight request is re-submitted to a survivor in submit order
+        with its ORIGINAL fleet rid, trace id and submit time — the
+        survivor re-prefills (cached stems in one page gather where its
+        prefix pool has them) and regenerates the deterministic token
+        stream, so completed tokens are bitwise identical to a
+        fault-free run. With no routable survivor the request sheds at
+        the front door."""
         for rec in self.schedulers[i].evict_for_requeue():
             rid = rec.trace_id     # the fleet-global id (we threaded it)
             # a drained prefill JOB stays in its phase: re-route it to a
@@ -384,6 +491,357 @@ class Router:
             return
         self.health.note_tick(i, self.clock() - t0)
 
+    # ------------------------------------------------------ rolling weight swap
+
+    def stamp_version(self, version: int) -> None:
+        """Stamp the param version the fleet was BUILT with (serving a
+        published version from startup) onto every replica — no swap, no
+        drain; call before traffic so record stamps, page epochs and the
+        skew tripwire carry the real version."""
+        for s in self.schedulers:
+            setter = getattr(s.engine, "set_param_version", None)
+            if setter is not None:
+                setter(version)
+        self._version = int(version)
+
+    @property
+    def swap_in_progress(self) -> bool:
+        return self._swap is not None
+
+    @property
+    def version(self) -> int:
+        """The fleet's committed param version (per-replica truth is in
+        ``stats()``'s ``replica{i}_version`` panel)."""
+        return self._version
+
+    def start_swap(self, params, *, version: Optional[int] = None,
+                   draft_params=None,
+                   config: Optional[SwapConfig] = None) -> int:
+        """Begin a ROLLING swap of the fleet onto ``params`` (module
+        docstring): one replica per tick is drained via the quarantine
+        requeue path (its in-flight requests replay on survivors — the
+        fleet never stops serving), swapped with zero recompiles
+        (``DecodeEngine.swap_params``), probed, and re-admitted. The
+        first swapped replica is the health-gated CANARY
+        (:class:`SwapConfig`); a breach inside its window rolls every
+        already-swapped replica back to the previous version fleet-wide.
+
+        The swap advances inside :meth:`tick` (one step per tick, so
+        live traffic interleaves); with no traffic pending, pump
+        :meth:`finish_swap`. ``version`` must be monotone (default:
+        committed + 1); ``draft_params`` rides the same transaction on
+        spec engines. Returns the target version."""
+        if self._swap is not None:
+            raise RuntimeError(
+                f"a rolling swap to version {self._swap['version']} is "
+                "already in progress")
+        n = len(self.schedulers)
+        if n < 2:
+            raise ValueError(
+                "a rolling swap needs >= 2 replicas (one drains while "
+                "the others serve); a single engine swaps via "
+                "DecodeEngine.swap_params after draining")
+        version = self._version + 1 if version is None else int(version)
+        if version <= self._version:
+            raise ValueError(
+                f"swap version {version} is not monotone (fleet is at "
+                f"{self._version}) — published versions only move "
+                "forward")
+        cfg = config or SwapConfig()
+        rank = (self.health.rank if self.health is not None
+                else (lambda i: 0))
+        # healthiest replica first: the canary must start from a clean
+        # health state or the gate would trip on pre-existing trouble
+        order = sorted(range(n), key=lambda i: (rank(i), i))
+        self._swap = {
+            "version": version, "params": params, "draft": draft_params,
+            "cfg": cfg, "order": order, "canary": order[0],
+            "canary_swapped": False, "ticks_left": cfg.canary_ticks,
+            "ttft_mark": 0, "done": [],
+            "prev_params": [s.engine._params for s in self.schedulers],
+            "prev_draft": [getattr(s.engine, "_draft_params", None)
+                           if getattr(s.engine, "spec_k", 0) else None
+                           for s in self.schedulers],
+            "prev_version": [getattr(s.engine, "param_version", 0)
+                             for s in self.schedulers],
+            "watcher": None,
+        }
+        log.info("rolling swap to param version %d started (canary "
+                 "replica %d, %d-tick window)", version, order[0],
+                 cfg.canary_ticks)
+        return version
+
+    def maybe_swap_published(self, watcher, *,
+                             config: Optional[SwapConfig] = None,
+                             draft_factory=None) -> Optional[int]:
+        """Poll a :class:`dtf_tpu.publish.PublishWatcher` and start a
+        rolling swap when it hands over a NEW verified version (corrupt
+        publishes were already skipped with a WARN inside the watcher —
+        the fleet keeps serving). ``draft_factory(params) ->
+        draft_params`` rebuilds the draft from the new weights (the
+        ``--draft_layers`` early-exit case). No-op while a swap is in
+        progress. Returns the version a swap was started for, else
+        None."""
+        if self._swap is not None:
+            return None
+        got = watcher.load_new()
+        if got is None:
+            return None
+        version, step, params = got
+        if version <= self._version:
+            watcher.note_applied(version)
+            return None
+        draft = draft_factory(params) if draft_factory is not None else None
+        v = self.start_swap(params, version=version, draft_params=draft,
+                            config=config)
+        self._swap["watcher"] = watcher
+        self._swap["step"] = step
+        return v
+
+    def finish_swap(self, max_ticks: int = 100000) -> None:
+        """Pump ticks until the in-progress swap commits or rolls back
+        (ticks with no traffic still advance the swap machine)."""
+        for _ in range(max_ticks):
+            if self._swap is None:
+                return
+            self.tick()
+        raise RuntimeError(f"swap still in progress after {max_ticks} "
+                           "ticks")
+
+    def _swap_span(self):
+        if self.telemetry is None:
+            return contextlib.nullcontext()
+        return self.telemetry.spans.span("serve_swap")
+
+    def _swap_replica(self, i: int, params, draft, version: int, *,
+                      probe: bool = True, mark=None) -> None:
+        """Drain replica ``i`` onto the rest of the fleet, swap its
+        weights, probe, re-admit — the per-replica step of the rolling
+        swap. In-flight requests requeue with their ORIGINAL rid/
+        submit_t (the PR 12 path), so a request spanning the swap
+        boundary replays WHOLE on exactly one version. ``mark`` (the
+        forward-swap callers' bookkeeping) runs the moment
+        ``swap_params`` returns — BEFORE the probe — so a replica whose
+        probe then raises is already recorded as swapped and a rollback
+        includes it (its weights DID flip)."""
+        self._swapping = i
+        try:
+            with self._swap_span():
+                self._requeue_from(i)
+                self.schedulers[i].engine.swap_params(
+                    params, draft_params=draft, version=version)
+        finally:
+            self._swapping = None
+        # ANY successful swap supersedes a pending version repair: the
+        # replica now holds the weights this swap installed — a later
+        # repair retry would revert it to the STALE rolled-back payload
+        # and split the fleet permanently
+        self._version_repair.pop(i, None)
+        self._repair_backoff.pop(i, None)
+        if mark is not None:
+            mark()
+        quarantined = (self.health is not None
+                       and self.health.state(i)
+                       == health_lib.QUARANTINED)
+        if probe and not quarantined:
+            # the same compiled decode, timed and fed to the watchdog: a
+            # replica that comes back wedged is caught BEFORE live
+            # traffic lands on it (and, for the canary, trips the gate)
+            fn = getattr(self.schedulers[i].engine, "probe", None)
+            if fn is not None:
+                t0 = self.clock()
+                fn()        # an exception here = swap failure (caller
+                #             rolls the fleet back)
+                if (self.health is not None
+                        and self.health.note_tick(i, self.clock() - t0)
+                        == health_lib.QUARANTINED):
+                    self._requeue_from(i)   # nothing in flight; no-op
+
+    def _advance_swap(self) -> None:
+        """One step of the rolling-swap state machine, run at the end of
+        every tick. Any exception inside a replica's swap step (the
+        ``wedge_in_swap`` chaos verb, a failed probe, a bad tree) rolls
+        the partial fleet back onto ONE version instead of propagating —
+        a swap can fail, the fleet cannot."""
+        sw = self._swap
+        if sw is None:
+            return
+        try:
+            if not sw["canary_swapped"]:
+                i = sw["canary"]
+
+                def mark_canary():
+                    sw["canary_swapped"] = True
+                    sw["ttft_mark"] = self.schedulers[i].ttft_count
+
+                self._swap_replica(i, sw["params"], sw["draft"],
+                                   sw["version"], mark=mark_canary)
+                return
+            if sw["ticks_left"] > 0:
+                cause = self._canary_breach()
+                if cause is not None:
+                    self._rollback_swap(f"canary breach: {cause}")
+                    return
+                sw["ticks_left"] -= 1
+                return
+            nxt = next((i for i in sw["order"]
+                        if i != sw["canary"] and i not in sw["done"]),
+                       None)
+            if nxt is None:
+                self._commit_swap()
+                return
+            self._swap_replica(nxt, sw["params"], sw["draft"],
+                               sw["version"],
+                               mark=lambda: sw["done"].append(nxt))
+        except Exception as e:  # noqa: BLE001 — swap-step failures roll
+            # back; only the rollback itself may quarantine a replica
+            self._rollback_swap(
+                f"swap step failed: {type(e).__name__}: {e}")
+
+    def _canary_breach(self) -> Optional[str]:
+        """The canary gate (SwapConfig docstring): health verdict first,
+        then the post-swap TTFT SLO floor. None = clean so far."""
+        sw = self._swap
+        i = sw["canary"]
+        if (self.health is not None
+                and self.health.state(i) != health_lib.HEALTHY):
+            return f"canary replica {i} health {self.health.state(i)}"
+        cfg = sw["cfg"]
+        if self.ttft_slo_s > 0.0 and cfg.slo_floor > 0.0:
+            # samples SINCE the canary swap, measured against the
+            # monotone counter (the deque is maxlen-bounded: an index
+            # mark into it goes stale once it wraps — a long-running
+            # server would otherwise never see a canary sample again).
+            # REQUEUED requests are excluded: their TTFT includes time
+            # lost on some OTHER replica's failure (original submit_t —
+            # the PR 12 contract), and a gate counting them would blame
+            # the new weights for an unrelated fault and blacklist a
+            # perfectly good version.
+            sched = self.schedulers[i]
+            new = sched.ttft_count - sw["ttft_mark"]
+            d, rq = sched._ttfts, sched._ttft_requeued
+            lo = max(0, len(d) - min(new, len(d)))
+            samples = [t for t, requeued in zip(
+                itertools.islice(d, lo, None),
+                itertools.islice(rq, lo, None)) if not requeued]
+            if len(samples) >= cfg.slo_min_samples:
+                ok = sum(1 for t in samples
+                         if t <= self.ttft_slo_s) / len(samples)
+                if ok < cfg.slo_floor:
+                    return (f"canary TTFT SLO ok-frac {ok:.3f} < floor "
+                            f"{cfg.slo_floor} over {len(samples)} "
+                            "completions")
+        return None
+
+    def _rollback_swap(self, cause: str) -> None:
+        """Fleet-wide rollback: every already-swapped replica (canary
+        included) drains and takes its PREVIOUS weights back, so the
+        fleet converges on one version. A replica that cannot even swap
+        back is quarantined out of traffic — the fleet keeps serving."""
+        sw = self._swap
+        self._swap = None
+        swapped = ([sw["canary"]] if sw["canary_swapped"] else []) \
+            + sw["done"]
+        log.warning(
+            "rolling swap to param version %d ROLLED BACK after %d "
+            "replica(s): %s", sw["version"], len(swapped), cause)
+        for i in reversed(swapped):
+            try:
+                self._swap_replica(i, sw["prev_params"][i],
+                                   sw["prev_draft"][i],
+                                   sw["prev_version"][i], probe=False)
+            except Exception as e:  # noqa: BLE001 — a replica wedged in
+                # BOTH directions leaves traffic via quarantine, not by
+                # failing the rollback of the rest of the fleet; the
+                # REPAIR record keeps it unroutable (probation must not
+                # re-admit a replica serving the rejected version) until
+                # _retry_version_repair re-aligns its weights
+                log.warning("replica %d failed to roll back (%r)", i, e)
+                self._version_repair[i] = (sw["prev_params"][i],
+                                           sw["prev_draft"][i],
+                                           sw["prev_version"][i])
+                if self.health is not None:
+                    self.health.quarantine(i, f"rollback failed: {e!r}")
+                    self._requeue_from(i)
+        self._swap_rollbacks += 1
+        self._last_swap = {"version": sw["version"],
+                           "outcome": "rolled_back", "cause": cause}
+        if sw["watcher"] is not None:
+            # a rolled-back version must not immediately re-swap on the
+            # next poll: only a NEWER republish may try again
+            sw["watcher"].skipped.add(sw["version"])
+        self._invalidate_stale_pages()
+
+    def _commit_swap(self) -> None:
+        sw = self._swap
+        self._swap = None
+        self._version = sw["version"]
+        self._swaps += 1
+        self._last_swap = {"version": sw["version"], "outcome": "done"}
+        if sw["watcher"] is not None:
+            sw["watcher"].note_applied(sw["version"])
+        self._invalidate_stale_pages()
+        log.info("rolling swap complete: fleet serving param version %d",
+                 sw["version"])
+
+    def _retry_version_repair(self, i: int) -> bool:
+        """Re-align a replica stuck on rolled-back weights (its reverse
+        swap failed) with the fleet's committed version — attempted at
+        every tick the health machine would otherwise let it back in,
+        BEFORE any probe or traffic. True once aligned."""
+        params, draft, version = self._version_repair[i]
+        try:
+            self._swap_replica(i, params, draft, version, probe=False)
+        except Exception as e:  # noqa: BLE001 — still broken: stays
+            # unroutable (the repair record); quarantine backoff paces
+            # the next try (health), or the tick backoff (health-less)
+            log.warning("replica %d version repair failed (%r)", i, e)
+            if self.health is not None:
+                self.health.quarantine(i, f"version repair failed: {e!r}")
+            else:
+                _, delay = self._repair_backoff.get(i, (0, 1))
+                self._repair_backoff[i] = (self._ticks + delay,
+                                           min(delay * 2, 1024))
+            return False
+        # the record was popped by _swap_replica on success
+        log.info("replica %d re-aligned to param version %d after a "
+                 "failed rollback", i, version)
+        return True
+
+    def _invalidate_stale_pages(self) -> None:
+        """Reclaim prefix pages of other param versions once the fleet
+        converged (lookups already epoch-gate them — this is the eager
+        half of invalidation; pages.py docstring). One pass per DISTINCT
+        store: a shared disaggregation pool must not be walked once per
+        mounting replica."""
+        seen: set[int] = set()
+        for s in self.schedulers:
+            store = getattr(s.engine, "page_store", None)
+            if store is None or id(store) in seen:
+                continue
+            seen.add(id(store))
+            freed = store.index.invalidate_stale(self._version)
+            if freed:
+                log.info("freed %d stale-version prefix page(s)", freed)
+
+    def _skew_check(self) -> None:
+        """The version-skew tripwire (ISSUE 14 satellite): WARN once when
+        replicas serve more than one param version OUTSIDE an in-progress
+        rolling swap; re-armed when the fleet converges."""
+        vs = {getattr(s.engine, "param_version", None)
+              for s in self.schedulers}
+        vs.discard(None)
+        if len(vs) > 1 and self._swap is None:
+            if not self._skew_warned:
+                self._skew_warned = True
+                log.warning(
+                    "fleet spans param versions %s outside a rolling "
+                    "swap — replicas are serving DIFFERENT weights "
+                    "(skew tripwire; re-armed on convergence)",
+                    sorted(vs))
+        elif len(vs) <= 1:
+            self._skew_warned = False
+
     # ----------------------------------------------------------- pump surface
 
     @property
@@ -397,14 +855,32 @@ class Router:
         watchdog; a quarantine verdict (slow/wedged/faulted) immediately
         drains that replica onto survivors, so the pump loop never calls
         into a wedged engine again."""
+        self._ticks += 1
         h = self.health
         if h is None:
-            for s in self.schedulers:
+            for i, s in enumerate(self.schedulers):
+                if i in self._version_repair:
+                    # paced by the tick backoff: a still-broken engine
+                    # must not re-validate + re-place the whole param
+                    # tree (and WARN) on every tick of a busy pump
+                    if self._ticks >= self._repair_backoff.get(i, (0, 1))[0]:
+                        self._retry_version_repair(i)
+                    continue
                 if s.pending:
                     s.tick()
             self._promote_handoffs()
+            self._advance_swap()
+            self._skew_check()
             return
         for i, s in enumerate(self.schedulers):
+            if i in self._version_repair:
+                # stuck on a rolled-back version: the repair must land
+                # before the health machine may re-admit it (routable()
+                # flips quarantine→probation lazily — let it, but no
+                # probe/traffic this tick either way)
+                if h.routable(i):
+                    self._retry_version_repair(i)
+                continue
             if not h.routable(i):
                 continue
             if not s.pending:
@@ -423,6 +899,8 @@ class Router:
             if h.note_tick(i, self.clock() - t0) == health_lib.QUARANTINED:
                 self._requeue_from(i)
         self._promote_handoffs()
+        self._advance_swap()
+        self._skew_check()
 
     def run_until_idle(self, max_ticks: int = 100000, *,
                        on_tick=None) -> None:
@@ -491,6 +969,18 @@ class Router:
         }
         if brief:
             return out
+        # the hot-swap panel (ISSUE 14): committed + per-replica active
+        # param versions (the skew tripwire's raw data — _skew_check
+        # WARNs on divergence outside a swap), swap/rollback counters
+        self._skew_check()
+        out["router_version"] = float(self._version)
+        out["router_swaps"] = float(self._swaps)
+        out["router_swap_rollbacks"] = float(self._swap_rollbacks)
+        out["router_swap_in_progress"] = float(self._swap is not None)
+        for i, s in enumerate(self.schedulers):
+            v = getattr(s.engine, "param_version", None)
+            if v is not None:
+                out[f"replica{i}_version"] = float(v)
         out["router_shed"] = float(self._shed_router
                                    + sum(s._shed for s in self.schedulers))
         out["router_timeouts"] = float(sum(s._timeouts
@@ -536,10 +1026,15 @@ class Router:
                 if k in st:
                     out[f"replica{i}_{k}"] = st[k]
         if self.telemetry is not None:
-            roll = self.telemetry.spans.rollup().get("router_wait")
+            rollup = self.telemetry.spans.rollup()
+            roll = rollup.get("router_wait")
             if roll is not None:
                 out["router_wait_p50_s"] = roll["p50_s"]
                 out["router_wait_p99_s"] = roll["p99_s"]
+            swap_roll = rollup.get("serve_swap")
+            if swap_roll is not None:
+                out["serve_swap_p50_s"] = swap_roll["p50_s"]
+                out["serve_swap_p99_s"] = swap_roll["p99_s"]
         return out
 
 
@@ -553,4 +1048,4 @@ def poisson_replay(router, arrivals, *, clock=time.perf_counter,
     return replay(router, arrivals, clock=clock, sleep=sleep)
 
 
-__all__ = ["Router", "poisson_replay"]
+__all__ = ["Router", "SwapConfig", "poisson_replay"]

@@ -144,6 +144,12 @@ class _Rec:
     timeout_kind: str = ""            # "ttft" | "total" on timeout
     error: str = ""                   # admission-failure cause on error
     requeued: bool = False            # re-admitted off a quarantined replica
+    #: the param VERSION whose weights decoded this request (ISSUE 14),
+    #: stamped at completion from the engine. Exactly ONE version per
+    #: request by construction: a rolling swap DRAINS a replica before
+    #: swapping it, so a request spanning the boundary replays whole on
+    #: one version (tokens cleared on requeue).
+    version: Optional[int] = None
 
 
 class Scheduler:
@@ -209,6 +215,16 @@ class Scheduler:
         self._next_id = 0
         self._tick = 0
         self._ttfts: collections.deque[float] = collections.deque(
+            maxlen=completed_cap)
+        #: MONOTONE count of TTFT samples ever recorded (the deque is
+        #: maxlen-bounded, so ``len(_ttfts)`` stops moving once full —
+        #: windowed consumers like the Router's canary SLO gate measure
+        #: "samples since a mark" against this counter instead), plus a
+        #: lockstep flag deque marking samples of REQUEUED requests:
+        #: their TTFT honestly includes time lost on a dead replica, so
+        #: the canary gate must not blame the new weights for them.
+        self._ttft_count = 0
+        self._ttft_requeued: collections.deque[bool] = collections.deque(
             maxlen=completed_cap)
         self._tok_lats: collections.deque[float] = collections.deque(
             maxlen=completed_cap)
@@ -277,6 +293,8 @@ class Scheduler:
     def poll(self, rid: int) -> dict:
         rec = self._recs[rid]
         out = {"status": rec.status, "tokens": list(rec.tokens)}
+        if rec.version is not None:
+            out["version"] = rec.version
         if rec.status == "shed":
             out["retry_after_s"] = rec.retry_after_s
         elif rec.status == "timeout":
@@ -377,6 +395,8 @@ class Scheduler:
                 rec.tokens.append(tok)
                 self._admitting = None
                 self._ttfts.append(rec.first_token_t - rec.submit_t)
+                self._ttft_requeued.append(rec.requeued)
+                self._ttft_count += 1
                 if done or self._budget_spent(rec):
                     self._finish(rec)
                 else:
@@ -488,6 +508,11 @@ class Scheduler:
         admission tiebreak."""
         return len(self._queue) + (self._admitting is not None)
 
+    @property
+    def ttft_count(self) -> int:
+        """Monotone TTFT-sample count (see ``_ttft_count``)."""
+        return self._ttft_count
+
     def _finish(self, rec: _Rec) -> None:
         rec.finish_t = rec.finish_t or self.clock()
         if len(rec.tokens) > 1:
@@ -504,6 +529,12 @@ class Scheduler:
         bounded retention window."""
         rec.status = status
         rec.finish_t = rec.finish_t or (self.clock() if now is None else now)
+        if status == "done":
+            # the version-stamp contract (ISSUE 14): every completed
+            # record names the param version that decoded it — the
+            # engine's CURRENT version is the whole request's version
+            # because a swap drains in-flight work first (see _Rec)
+            rec.version = getattr(self.engine, "param_version", None)
         tracer = self._tracer()
         if tracer is not None:
             # the request's whole lifecycle as ONE slice on its own track

@@ -189,6 +189,15 @@ def child_serve():
     spec_k = int(os.environ.get("DTF_SERVE_SPEC_K", "0"))
     draft_mode = os.environ.get("DTF_SERVE_DRAFT", "self")
     prefill_reps = int(os.environ.get("DTF_SERVE_PREFILL_REPLICAS", "0"))
+    # ISSUE 14 axis: start a ROLLING weight swap at this router tick
+    # (0 = off; needs replicas >= 2). The row's A/B partner is the same
+    # fleet + arrivals with no swap — TTFT p99 across the swap vs
+    # without IS the zero-downtime claim, measured.
+    swap_at = int(os.environ.get("DTF_SERVE_SWAP", "0"))
+    if swap_at and replicas < 2:
+        raise SystemExit("DTF_SERVE_SWAP needs DTF_SERVE_REPLICAS >= 2 "
+                         "(a rolling swap drains one replica while the "
+                         "others serve)")
     # long-prompt BURST (the disaggregation row's workload): a contiguous
     # run of requests mid-stream carries a LONG unique prompt; the row
     # then reports short-request TTFT separately — the starvation metric
@@ -273,7 +282,17 @@ def child_serve():
     fault_plan = ServeFaultPlan.from_env()
     fault_queue = n_slots if fault_plan is not None else 0
 
-    def serve_side(prefix_on, inject=False, disagg=0, spec_on=True):
+    params_v2 = None
+    if swap_at:
+        # the "retrained" weights a mid-run publish would deliver: a
+        # fresh init — the swap machinery's cost does not depend on how
+        # far the weights moved, only the placement + drain do
+        params_v2 = model.init(
+            jax.random.PRNGKey(1),
+            jax.numpy.zeros((1, 1), jax.numpy.int32))["params"]
+
+    def serve_side(prefix_on, inject=False, disagg=0, spec_on=True,
+                   swap=False):
         use_spec = spec_k if spec_on else 0
         pool = (max_len // page) * 2 if prefix_on else 0
         # on a disaggregation ROW, both sides get eager saves AND the
@@ -324,7 +343,20 @@ def child_serve():
             # on measured tick duration); installed AFTER warm-up so the
             # warm decode calls don't consume the seeded tick budget
             install_serve_fault(fault_plan, sched)
-        wall = replay(sched, arrivals)
+        on_tick = None
+        if swap:
+            from dtf_tpu.serve import SwapConfig
+
+            ticks = [0]
+
+            def on_tick():
+                ticks[0] += 1
+                if ticks[0] == swap_at and not sched.swap_in_progress:
+                    sched.start_swap(params_v2,
+                                     config=SwapConfig(canary_ticks=4))
+        wall = replay(sched, arrivals, on_tick=on_tick)
+        if swap and sched.swap_in_progress:
+            sched.finish_swap()
         polls = [sched.poll(r) for r in range(n_req)]
         statuses = {}
         for p in polls:
@@ -361,6 +393,15 @@ def child_serve():
             out["draft_fallbacks"] = counters.get("draft_fallbacks", 0)
         if disagg:
             out["handoffs"] = st.get("router_handoffs", 0.0)
+        if swap:
+            # the zero-downtime fence data: a swap mid-run must leave
+            # every request done (statuses clean) and its TTFT p99 is
+            # read against the no-swap side of the same row
+            out["statuses"] = statuses
+            out["swaps"] = st.get("router_swaps", 0.0)
+            out["swap_rollbacks"] = st.get("router_swap_rollbacks", 0.0)
+            out["final_version"] = st.get("router_version", 0.0)
+            out["requeued"] = st.get("router_requeued", 0.0)
         if long_ids:
             # per-class TTFT: the SHORT requests' tail is the starvation
             # metric — the burst must not inflate it fleet-wide. Reported
@@ -419,8 +460,15 @@ def child_serve():
     # row compares against the SAME pages with routing off, a prefix row
     # against pages off, a spec row against speculation off — always the
     # same seeded arrivals.
-    serve = serve_side(prefix_on=hit_ratio > 0, disagg=prefill_reps)
-    if prefill_reps:
+    serve = serve_side(prefix_on=hit_ratio > 0, disagg=prefill_reps,
+                       swap=swap_at > 0)
+    if swap_at:
+        # the swap A/B: the SAME fleet shape (disagg axis included), same
+        # arrivals, no swap — the TTFT p99 delta between the sides is
+        # what the mid-run swap cost
+        serve_off = serve_side(prefix_on=hit_ratio > 0,
+                               disagg=prefill_reps)
+    elif prefill_reps:
         serve_off = serve_side(prefix_on=True, disagg=0)
     elif spec_k:
         serve_off = serve_side(prefix_on=hit_ratio > 0, spec_on=False)
@@ -470,7 +518,7 @@ def child_serve():
            "replicas": replicas, "prefix_hit_ratio": hit_ratio,
            "page_size": page if hit_ratio > 0 else 0,
            "spec_k": spec_k, "draft": draft_mode if spec_k else "",
-           "prefill_replicas": prefill_reps,
+           "prefill_replicas": prefill_reps, "swap_at_tick": swap_at,
            "long_frac": long_frac, "t_p_long": t_p_long if long_frac else 0,
            # architecture labels keying the tuner's spec_k winner
            # selection (tune/search.py seed_spec_k_entries)
@@ -543,6 +591,11 @@ def main(key="decode"):
             # goodput/TTFT p99/shed fraction both sides in one row
             {"DTF_SERVE_REPLICAS": "2",
              "DTF_FAULT_INJECT": "wedge_replica@6:replica=1"},
+            # hot-swap A/B (ISSUE 14): a rolling weight swap starts at a
+            # seeded router tick mid-replay — TTFT p99 across the swap
+            # vs the no-swap side on the same seeded arrivals (the
+            # zero-downtime fence), all requests terminal `done`
+            {"DTF_SERVE_REPLICAS": "2", "DTF_SERVE_SWAP": "6"},
             # ISSUE 13: draft-k sweep — each row carries a spec-off side
             # on the same arrivals; self-draft is the acceptance upper
             # bound (measures the machinery), and the tuner's spec_k

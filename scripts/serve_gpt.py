@@ -113,6 +113,27 @@ flags.DEFINE_float("health_wedge_s", 0.0, "health watchdog: single-tick "
 flags.DEFINE_float("health_probation_s", 0.0, "health watchdog: "
                    "quarantine→probation delay in seconds (0 = library "
                    "default)")
+flags.DEFINE_string("publish_dir", "", "serve PUBLISHED weights (ISSUE "
+                    "14): restore params from this publish dir's "
+                    "versioned manifest instead of the logdir "
+                    "checkpoint; the JSON line reports the version "
+                    "actually served")
+flags.DEFINE_integer("publish_version", 0, "with --publish_dir: serve "
+                     "exactly this published version — NO fallback past "
+                     "corruption (the explicit-step restore contract); "
+                     "0 = newest servable version (guarded walk, WARNs "
+                     "past a corrupt newest)")
+flags.DEFINE_integer("swap_poll_ticks", 0, "with --publish_dir and "
+                     "--replicas >= 2: poll the publish dir every N "
+                     "scheduler ticks and ROLL new versions across the "
+                     "fleet with zero downtime (drain one replica, "
+                     "swap, probe, re-admit; the first replica is a "
+                     "health-gated canary — docs/SERVING.md); 0 = "
+                     "serve the startup version only")
+flags.DEFINE_integer("canary_ticks", 8, "rolling swap: router ticks the "
+                     "first swapped replica serves alone before the "
+                     "rest of the fleet follows; a health/SLO breach "
+                     "inside the window rolls the fleet back")
 flags.DEFINE_string("requests", "", "semicolon-separated comma-lists of "
                     "token ids; empty = Poisson load")
 flags.DEFINE_integer("n_new", 32, "max new tokens per explicit request")
@@ -140,7 +161,11 @@ flags.DEFINE_integer("stats_every", 0, "liveness heartbeat: every N "
                      "occupancy, TTFT p50/p99, ttft_slo_ok_frac); 0 = off")
 flags.DEFINE_float("ttft_slo_frac", 0.0, "with --stats_every and "
                    "--ttft_slo: log a WARNING when the TTFT SLO-ok "
-                   "fraction drops below this floor (once per excursion)")
+                   "fraction drops below this floor (once per "
+                   "excursion); with --swap_poll_ticks it is ALSO the "
+                   "rolling swap's canary rollback floor — a canary "
+                   "whose post-swap SLO-ok fraction dips under it rolls "
+                   "the fleet back")
 flags.DEFINE_string("trace_out", "", "write a Perfetto-loadable "
                     "chrome-trace JSON of per-request lifecycles (queue "
                     "wait, prefill chunks, decode steps, all tagged with "
@@ -179,11 +204,31 @@ def main(argv):
                          devices=jax.devices()[:dp * tp])
 
     ckpt_dir = os.path.join(FLAGS.logdir, "ckpt")
+    if FLAGS.publish_version and not FLAGS.publish_dir:
+        raise app.UsageError(
+            "--publish_version needs --publish_dir (it names a PUBLISHED "
+            "version, not a checkpoint step)")
+    if FLAGS.swap_poll_ticks:
+        if not FLAGS.publish_dir:
+            raise app.UsageError(
+                "--swap_poll_ticks needs --publish_dir (there is nothing "
+                "to poll for new versions without a publish dir)")
+        if FLAGS.replicas < 2:
+            raise app.UsageError(
+                "--swap_poll_ticks needs --replicas >= 2: a rolling swap "
+                "drains one replica while the others serve (a single "
+                "engine cannot swap with zero downtime)")
     try:
         # kv dtype + page-size legality checked HERE (against the manifest
-        # architecture and the serving shape), not inside the AOT build
+        # architecture and the serving shape), not inside the AOT build.
+        # With --publish_dir the architecture manifest may live next to
+        # the publish manifest (train_gpt writes both); the logdir ckpt
+        # manifest stays the fallback.
+        manifest = (load_model_config(FLAGS.publish_dir)
+                    if FLAGS.publish_dir else None) \
+            or load_model_config(ckpt_dir)
         decode_cfg = dflags.resolve_decode_config(
-            FLAGS, load_model_config(ckpt_dir), max_len=FLAGS.max_len,
+            FLAGS, manifest, max_len=FLAGS.max_len,
             kv_page_size=FLAGS.kv_page_size if FLAGS.prefix_pages else 0)
     except ValueError as e:
         raise app.UsageError(str(e))
@@ -207,15 +252,28 @@ def main(argv):
                                   "attn_global_every"],
                               kv_cache_dtype=decode_cfg["kv_cache_dtype"])
 
-    ckpt = Checkpointer(ckpt_dir)
-    if ckpt.latest_step() is None:
-        raise app.UsageError(f"no checkpoint under {ckpt_dir}")
-    # guarded latest-step restore: a corrupt newest checkpoint WARNs and
-    # serves the next older readable step instead of dying at startup
-    params = ckpt.restore_params()
-    step = ckpt.last_restored_step
-    print(f"restored params of step {step} from {ckpt_dir}",
-          file=sys.stderr)
+    served_version = 0
+    if FLAGS.publish_dir:
+        from dtf_tpu.publish import load_published
+
+        try:
+            served_version, step, params = load_published(
+                FLAGS.publish_dir, FLAGS.publish_version or None)
+        except (FileNotFoundError, ValueError, RuntimeError) as e:
+            raise app.UsageError(str(e))
+        print(f"serving published version {served_version} (train step "
+              f"{step}) from {FLAGS.publish_dir}", file=sys.stderr)
+    else:
+        ckpt = Checkpointer(ckpt_dir)
+        if ckpt.latest_step() is None:
+            raise app.UsageError(f"no checkpoint under {ckpt_dir}")
+        # guarded latest-step restore: a corrupt newest checkpoint WARNs
+        # and serves the next older readable step instead of dying at
+        # startup
+        params = ckpt.restore_params()
+        step = ckpt.last_restored_step
+        print(f"restored params of step {step} from {ckpt_dir}",
+              file=sys.stderr)
     if sharded:
         params = shard_tree(params, mesh, gpt.tp_rules)
 
@@ -334,22 +392,61 @@ def main(argv):
                 max_queue=FLAGS.max_queue)
     except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
         raise app.UsageError(str(e))
+    if served_version:
+        # stamp the published version the fleet was BUILT with, so record
+        # stamps / page epochs / the skew tripwire carry the real number
+        if FLAGS.replicas > 1:
+            sched.stamp_version(served_version)
+        else:
+            engines[0].set_param_version(served_version)
     if tel is not None:
         if FLAGS.trace_out:
             for e in engines:
                 e.annotate_traces = True
         tel.start()
 
+    # the hot-swap poller: every --swap_poll_ticks ticks, a NEW published
+    # version (digest-verified; corrupt publishes skipped with a WARN)
+    # starts a rolling swap across the fleet — the serve loop itself
+    # never pauses (docs/SERVING.md "Rolling weight swap")
+    watcher = None
+    swap_tick = None
+    if FLAGS.swap_poll_ticks:
+        from dtf_tpu.publish import PublishWatcher
+        from dtf_tpu.serve import SwapConfig
+
+        watcher = PublishWatcher(FLAGS.publish_dir,
+                                 applied_version=served_version)
+        # with a TTFT SLO configured, --ttft_slo_frac doubles as the
+        # canary's rollback floor (the same compliance fraction the
+        # heartbeat warns on); health verdicts gate regardless
+        swap_cfg = SwapConfig(
+            canary_ticks=FLAGS.canary_ticks,
+            slo_floor=(FLAGS.ttft_slo_frac
+                       if FLAGS.ttft_slo > 0 else 0.0))
+        draft_factory = None
+        if FLAGS.draft_layers:
+            draft_factory = lambda p: gpt.draft_truncate(  # noqa: E731
+                cfg, p, FLAGS.draft_layers)[1]
+        ticks = [0]
+
+        def swap_tick():
+            ticks[0] += 1
+            if ticks[0] % FLAGS.swap_poll_ticks == 0:
+                sched.maybe_swap_published(watcher, config=swap_cfg,
+                                           draft_factory=draft_factory)
+
     # serve-side chaos (DTF_FAULT_INJECT=wedge_replica@tick:replica=k |
-    # slow_decode@tick | poison_request@n) rides the launcher the way
-    # PR 11's verbs ride the trainers — the chaos matrix drives this.
+    # slow_decode@tick | poison_request@n | wedge_in_swap@n:replica=k |
+    # corrupt_publish@n) rides the launcher the way PR 11's verbs ride
+    # the trainers — the chaos matrix drives this.
     from dtf_tpu.fault.inject import ServeFaultPlan
 
     fault_plan = ServeFaultPlan.from_env()
     if fault_plan is not None:
         from dtf_tpu.serve import install_serve_fault
 
-        install_serve_fault(fault_plan, sched)
+        install_serve_fault(fault_plan, sched, watcher=watcher)
 
     heartbeat = None
     if FLAGS.stats_every:
@@ -359,7 +456,12 @@ def main(argv):
                               slo_floor=FLAGS.ttft_slo_frac,
                               flight=tel.flight if tel is not None
                               else None)
-    on_tick = heartbeat.maybe_emit if heartbeat is not None else None
+    hooks = [h for h in
+             (heartbeat.maybe_emit if heartbeat is not None else None,
+              swap_tick) if h is not None]
+    on_tick = (None if not hooks
+               else hooks[0] if len(hooks) == 1
+               else (lambda: [h() for h in hooks]))
 
     eos = FLAGS.eos_id if FLAGS.eos_id >= 0 else None
     t0 = time.perf_counter()
@@ -407,6 +509,10 @@ def main(argv):
                 deadline_s=FLAGS.deadline)) for t, req in arrivals)
         replay(sched, arrivals, on_tick=on_tick)
         rids = list(range(FLAGS.n_requests))   # submit order = id order
+    if FLAGS.swap_poll_ticks and getattr(sched, "swap_in_progress", False):
+        # a swap that started near the end of the run converges before
+        # the final stats line (idle ticks still advance the machine)
+        sched.finish_swap()
     wall = time.perf_counter() - t0
 
     if FLAGS.emit_tokens:
@@ -421,6 +527,12 @@ def main(argv):
     cache_bytes = sum(e.cache_bytes() for e in engines)
     out = {"mode": "requests" if FLAGS.requests else "poisson",
            "backend": jax.default_backend(), "step": step,
+           # the published version serving STARTED on (0 = checkpoint
+           # serving) and the one the fleet ended on after any rolling
+           # swaps — stats() adds router_version/replica{i}_version
+           "served_version": served_version,
+           "final_version": int(sched.version if FLAGS.replicas > 1
+                                else engines[0].param_version),
            "replicas": FLAGS.replicas,
            "prefill_replicas": FLAGS.prefill_replicas,
            # the RESOLVED draft width (decode replicas; 0 = spec off) —

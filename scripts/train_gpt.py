@@ -81,6 +81,15 @@ flags.DEFINE_integer("eval_every", 0, "held-out eval (val.bin or held-out "
                      "synthetic) every N steps; 0 = final eval only. On the "
                      "pipelined path the eval step runs un-pipelined "
                      "against the same stacked params.")
+flags.DEFINE_string("publish_dir", "", "weight hot-swap publishing "
+                    "(ISSUE 14): every --publish_every steps, emit a "
+                    "params-only snapshot as the next monotone VERSION "
+                    "into this dir (atomic manifest + content digest); "
+                    "serve_gpt --publish_dir/--swap_poll_ticks rolls "
+                    "new versions across a live fleet with zero "
+                    "downtime (docs/RESILIENCE.md §9)")
+flags.DEFINE_integer("publish_every", 100, "with --publish_dir: publish "
+                     "a version every N steps (plus once at end of run)")
 FLAGS = flags.FLAGS
 
 
@@ -335,13 +344,26 @@ def main(argv):
     # flags (a mismatch used to garble decode silently)
     from dtf_tpu.checkpoint import save_model_config
 
-    save_model_config(ckpt.directory, {
+    manifest_cfg = {
         "model": "gpt", "size": FLAGS.size,
         "kv_heads": FLAGS.kv_heads, "attn_window": FLAGS.attn_window,
         "attn_global_every": FLAGS.attn_global_every,
         "moe_every": FLAGS.moe_every, "vocab_size": cfg.vocab_size,
         "d_model": cfg.d_model, "layers": cfg.layers, "heads": cfg.heads,
-        "d_ff": cfg.d_ff, "kv_cache_dtype": ""})
+        "d_ff": cfg.d_ff, "kv_cache_dtype": ""}
+    save_model_config(ckpt.directory, manifest_cfg)
+    publisher = None
+    # only the checkpoint-owning process publishes (the PreemptionHook
+    # ckpt=None idiom): under the fake-hosts harness every worker is its
+    # own process_index-0 program, and N publishers racing one manifest
+    # would commit digests over half-written dirs
+    if FLAGS.publish_dir and getattr(info, "participates_in_save", True):
+        from dtf_tpu.publish import ParamPublisher
+
+        publisher = ParamPublisher(FLAGS.publish_dir)
+        # the architecture manifest rides next to the publish manifest so
+        # a fleet serving ONLY the publish dir still resolves the config
+        save_model_config(FLAGS.publish_dir, manifest_cfg)
     place_batch = lambda b: shard_batch(  # noqa: E731
         gpt.zigzag_batch(b, mesh.shape["seq"])
         if (sp and FLAGS.attn_impl == "zigzag") else b,
@@ -352,18 +374,27 @@ def main(argv):
         FLAGS, info, mesh, shardings, eval_fn, writer,
         place_batch, kind="gpt", mode="clm", vocab_size=cfg.vocab_size,
         batch_shardings=kwargs.get("batch_shardings"), telemetry=tel)
+    from dtf_tpu.fault import inject
+    from dtf_tpu.hooks import PublishHook
+
+    hooks = [LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
+                         tokens_per_step=tokens_per_step,
+                         model_flops_per_step=model_flops,
+                         telemetry=tel),
+             CheckpointHook(ckpt, FLAGS.checkpoint_every),
+             *([PublishHook(publisher, FLAGS.publish_every)]
+               if publisher is not None else []),
+             PreemptionHook(ckpt),
+             *([eval_hook] if eval_hook else []),
+             StopAtStepHook(FLAGS.train_steps),
+             *profiler_hooks(FLAGS, telemetry=tel,
+                             flops_per_step=model_flops)]
+    fault = inject.maybe_hook(host_index=info.process_id,
+                              checkpointer=ckpt, publisher=publisher)
+    if fault is not None:
+        hooks.insert(0, fault)   # injected faults land before save hooks
     trainer = Trainer(
-        step, mesh,
-        hooks=[LoggingHook(writer, FLAGS.log_every, lr_schedule=sched,
-                           tokens_per_step=tokens_per_step,
-                           model_flops_per_step=model_flops,
-                           telemetry=tel),
-               CheckpointHook(ckpt, FLAGS.checkpoint_every),
-               PreemptionHook(ckpt),
-               *([eval_hook] if eval_hook else []),
-               StopAtStepHook(FLAGS.train_steps),
-               *profiler_hooks(FLAGS, telemetry=tel,
-                               flops_per_step=model_flops)],
+        step, mesh, hooks=hooks,
         checkpointer=ckpt,
         place_batch=place_batch,
         telemetry=tel)

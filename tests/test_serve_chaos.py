@@ -265,3 +265,210 @@ def test_chaos_launcher_wedge_replica_rides_env(tmp_path):
     assert any(ln.startswith('{"serve_heartbeat"')
                for ln in stderr.splitlines()), \
         "launcher: no heartbeat survived the wedge"
+
+
+# ---------------------------------------------------------------------------
+# hot-swap chaos (ISSUE 14): the acceptance fleet + corrupt publish +
+# the publish-serving launcher
+# ---------------------------------------------------------------------------
+
+def test_chaos_rolling_swap_spec_and_shared_pages_bitwise(gpt_setup):
+    """The ISSUE 14 acceptance fleet: >= 2 replicas with SPECULATION and
+    SHARED prefix pages (disaggregation) on, rolled to new weights
+    mid-traffic — zero requests end shed/timeout/error, the swapped
+    fleet's tokens are bitwise identical to a fresh fleet restored from
+    the same version, no page ever crosses versions (pinned stays 0),
+    and every per-replica program stays trace-pinned."""
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.serve import SwapConfig
+
+    cfg, model, params = gpt_setup
+    params2 = gpt_model_init(cfg, seed=1)
+
+    def fleet(p):
+        r = Router.build(cfg, p, n_replicas=3, n_slots=2,
+                         max_len=MAX_LEN, prefill_chunk=4,
+                         kv_page_size=4, prefix_pages=12,
+                         prefill_replicas=1,
+                         draft_cfg=cfg, draft_params=p, spec_k=2,
+                         health=HealthConfig(**_CHAOS_HEALTH))
+        return r
+
+    router = fleet(params)
+    # stem-shared traffic (page-aligned) + unique tails: pages AND spec
+    # both carry real work across the swap
+    stem = list(range(1, 9))
+    rng = np.random.default_rng(3)
+    reqs = [dict(prompt=stem + rng.integers(0, 128, 4).tolist(),
+                 max_new=int(rng.integers(3, 7)),
+                 temperature=0.0 if i % 2 else 0.8, seed=40 + i)
+            for i in range(8)]
+    rids = []
+    for i, r in enumerate(reqs[:5]):
+        rids.append(router.submit(Request(**r)))
+        router.tick()
+    router.start_swap(params2, version=1,
+                      config=SwapConfig(canary_ticks=2))
+    for r in reqs[5:]:
+        rids.append(router.submit(Request(**r)))
+        router.tick()
+    router.drain()
+    router.finish_swap()
+    st = router.stats()
+    assert st["router_swaps"] == 1.0 and st["router_swap_rollbacks"] == 0.0
+    assert all(st[f"replica{i}_version"] == 1.0 for i in range(3)), st
+    polls = [router.poll(rid) for rid in rids]
+    assert all(p["status"] == "done" for p in polls), \
+        f"swap: non-done terminal statuses {[p['status'] for p in polls]}"
+    # every record stamped; streams bitwise per the STAMPED version
+    params_of = {0: params, 1: params2}
+    for r, p in zip(reqs, polls):
+        assert p["version"] in (0, 1)
+        assert p["tokens"] == _offline(model, params_of[p["version"]], r), \
+            f"swap: tokens diverged for {r} at version {p['version']}"
+    for s in router.schedulers:
+        stats = s.engine.prefix_stats()
+        assert stats.get("pinned", 0) == 0, f"swap: leaked pins {stats}"
+    want = {"prefill": 1, "decode": 1}
+    for i, tc in enumerate(router.trace_counts()):
+        base = {k: v for k, v in tc.items() if not k.startswith("page_")}
+        if i == 0:                       # prefill replica: no draft
+            assert base == want, tc
+        else:
+            assert base == {**want, "draft_prefill": 1, "draft": 1}, tc
+
+    # the bitwise fresh-fleet cross-check at the TARGET version
+    fresh = fleet(params2)
+    fresh.stamp_version(1)
+    for r, p in zip(reqs, polls):
+        if p["version"] != 1:
+            continue
+        frid = fresh.submit(Request(**r))
+        fresh.drain()
+        assert fresh.result(frid) == p["tokens"], \
+            f"swap: swapped fleet != restored fleet for {r}"
+
+
+def gpt_model_init(cfg, seed):
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.models import gpt
+
+    model = gpt.GPT(_dc.replace(cfg, decode_len=MAX_LEN))
+    return model.init(jax.random.PRNGKey(seed),
+                      jnp.zeros((1, 1), jnp.int32))["params"]
+
+
+def test_chaos_corrupt_publish_fleet_keeps_serving(gpt_setup, tmp_path):
+    """corrupt_publish: the watcher's digest check skips a damaged
+    publish with a WARN — the live fleet keeps serving its version and
+    a later clean republish rolls normally."""
+    from dtf_tpu.publish import ParamPublisher, PublishWatcher
+    from dtf_tpu.serve import SwapConfig
+
+    cfg, model, params = gpt_setup
+    pub = ParamPublisher(str(tmp_path))
+    v1 = pub.publish(10, params)
+    router = Router.build(cfg, params, n_replicas=2, n_slots=2,
+                          max_len=MAX_LEN, prefill_chunk=5,
+                          health=HealthConfig(**_CHAOS_HEALTH))
+    router.stamp_version(v1)
+    watcher = PublishWatcher(str(tmp_path), applied_version=v1)
+    plan = ServeFaultPlan.parse("corrupt_publish@0")
+    state = install_serve_fault(plan, router, watcher=watcher,
+                                emit=lambda line: None)
+    pub.publish(20, gpt_model_init(cfg, seed=2))     # v2 — to be damaged
+    assert router.maybe_swap_published(watcher) is None
+    assert state.fired, "corrupt_publish never fired"
+    assert watcher.skipped == {2}
+    # the fleet NEVER left v1 and still serves bitwise
+    reqs = _requests(4, seed=11)
+    rids = [router.submit(Request(**r)) for r in reqs]
+    router.drain()
+    for r, rid in zip(reqs, rids):
+        p = router.poll(rid)
+        assert p["version"] == v1
+        assert p["tokens"] == _offline(model, params, r)
+    assert router.stats()["router_version"] == float(v1)
+    # a clean republish (a NEWER version) rolls normally
+    params3 = gpt_model_init(cfg, seed=3)
+    v3 = pub.publish(30, params3)
+    assert router.maybe_swap_published(
+        watcher, config=SwapConfig(canary_ticks=1)) == v3
+    router.finish_swap()
+    assert router.stats()["router_version"] == float(v3)
+    rid = router.submit(Request(**reqs[0]))
+    router.drain()
+    assert router.poll(rid)["tokens"] == _offline(model, params3, reqs[0])
+
+
+def test_chaos_launcher_publish_serving_and_guarded_fallback(tmp_path):
+    """launcher: train_gpt --publish_dir emits versions; serve_gpt
+    --publish_dir reports the version ACTUALLY served — the newest on a
+    clean dir, the older one (guarded walk, WARN) when the newest is
+    corrupt, and an EXPLICITLY requested corrupt version fails loudly
+    instead of falling back (the restore(step=) contract)."""
+    pub_dir = str(tmp_path / "publish")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "train_gpt.py"),
+         "--size=tiny", "--train_steps=4", "--batch_size=16",
+         "--seq_len=32", "--checkpoint_every=2", f"--logdir={tmp_path}",
+         f"--publish_dir={pub_dir}", "--publish_every=2"],
+        env=_env(), capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    from dtf_tpu.publish import read_manifest
+
+    m = read_manifest(pub_dir)
+    assert m is not None and m["version"] == 2, m
+
+    _, stats, _ = _serve(tmp_path, f"--publish_dir={pub_dir}")
+    assert stats["served_version"] == 2 and stats["final_version"] == 2
+    assert stats["request_statuses"] == {"done": 5}
+    assert all(stats[f"replica{i}_version"] == 2.0 for i in range(2))
+
+    # live mid-run roll: start on v1 EXPLICITLY, poll the publish dir
+    # every 2 ticks — the fleet rolls to v2 while serving, zero failures
+    _, stats, _ = _serve(tmp_path, f"--publish_dir={pub_dir}",
+                         "--publish_version=1", "--swap_poll_ticks=2",
+                         "--canary_ticks=2")
+    assert stats["served_version"] == 1 and stats["final_version"] == 2, \
+        f"launcher: rolling swap never converged ({stats})"
+    assert stats["router_swaps"] == 1.0
+    assert stats["request_statuses"] == {"done": 5}
+
+    # crash_in_publish rides train_gpt: the trainer DIES mid-publish and
+    # the manifest (and therefore serving) still names version 2
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "train_gpt.py"),
+         "--size=tiny", "--train_steps=6", "--batch_size=16",
+         "--seq_len=32", "--checkpoint_every=2", f"--logdir={tmp_path}",
+         f"--publish_dir={pub_dir}", "--publish_every=2"],
+        env=_env(DTF_FAULT_INJECT="crash_in_publish@6"),
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode != 0, "launcher: crash_in_publish never fired"
+    assert "crash_in_publish" in proc.stdout, proc.stdout[-800:]
+    assert read_manifest(pub_dir)["version"] == 2, \
+        "launcher: a crashed publish moved the manifest"
+
+    from dtf_tpu.fault.inject import corrupt_publish_version
+
+    corrupt_publish_version(pub_dir, 2)
+    _, stats, stderr = _serve(tmp_path, f"--publish_dir={pub_dir}")
+    assert stats["served_version"] == 1, \
+        f"launcher: corrupt newest not walked past ({stats})"
+    assert stats["request_statuses"] == {"done": 5}
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "serve_gpt.py"),
+         f"--logdir={tmp_path}", f"--publish_dir={pub_dir}",
+         "--publish_version=2", "--replicas=2", "--n_slots=2",
+         "--max_len=48", "--requests=5,9,2", "--n_new=4"],
+        env=_env(), capture_output=True, text=True, timeout=420)
+    assert proc.returncode != 0, \
+        "launcher: explicit corrupt version served instead of failing"
+    assert "digest" in (proc.stderr + proc.stdout), proc.stderr[-800:]

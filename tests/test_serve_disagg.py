@@ -109,6 +109,9 @@ def test_shared_store_compat_checks(params):
 
 # -------------------------------------------------------- routed identity
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 14 round; the PR 13 idiom):
+# the full routed-identity matrix rides the slow pyramid — the fast tier
+# keeps the starvation/handoff/wedge coverage on the same fleet
 def test_disagg_router_token_identity(params, fleet):
     """The full disaggregated path — prefill replica saves, handoff,
     decode replica gathers the chain and serves — is token-identical to

@@ -150,6 +150,9 @@ def test_eviction_churn_token_identity(params):
     assert eng._prefix.pinned() == 0
 
 
+@pytest.mark.slow  # tier-1 re-budget (ISSUE 14 round; the PR 13 idiom):
+# int8 decode identity stays fast in test_serve.py; the int8+pages
+# pinned-seed matrix rides the slow pyramid
 def test_int8_pages_token_identity_pinned_seed(params):
     """Quantized KV + prefix pages: pages carry the int8 values AND their
     scales bitwise, so with chunk-aligned pages (page_size a multiple of

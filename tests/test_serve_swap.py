@@ -1,0 +1,721 @@
+"""Zero-downtime weight hot-swap (ISSUE 14, tier-1 fast).
+
+Four layers, cheapest first: the PUBLISH transport (atomic versioned
+manifest, content digest, crash-mid-publish atomicity, explicit-version
+no-fallback contract), the page-EPOCH invariant (a cached stem can never
+serve stale-weight KV), the Router's rolling-swap state machine on fake
+engines (canary gate, health/SLO rollback, wedge_in_swap partial-fleet
+rollback, version-skew tripwire), and the real-engine proofs — engine
+``swap_params`` with ``trace_counts`` pinned and bitwise token identity,
+plus THE tier-1 swap smoke: a tiny real Trainer publishes 2 versions and
+a 2-replica fleet rolls twice with zero failed requests, every completed
+record version-stamped.
+
+Real-sleep/launcher scenarios (corrupt_publish on a live fleet, spec +
+shared-pages rolling swap, serve_gpt --publish_dir e2e) ride the slow
+tier in tests/test_serve_chaos.py.
+"""
+
+import dataclasses
+import logging
+
+import numpy as np
+import pytest
+
+from dtf_tpu.fault.inject import (FaultPlan, InjectedCrash, ServeFaultPlan,
+                                  corrupt_publish_version)
+from dtf_tpu.publish import (ParamPublisher, PublishWatcher, load_published,
+                             read_manifest)
+from dtf_tpu.serve import Request, Router, SwapConfig, install_serve_fault
+from dtf_tpu.serve.health import HealthConfig
+from dtf_tpu.serve.pages import PrefixIndex
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class _FakeEngine:
+    """Host-only engine with the hot-swap surface: tokens depend on the
+    param version, so a swap is visible in the stream and version stamps
+    are checkable without a backend."""
+
+    n_slots = 2
+    max_len = 64
+    prefill_chunk = 64
+    spec_k = 0
+
+    def __init__(self):
+        self.param_version = 0
+        self.counters = {"param_swaps": 0}
+        self._params = {"w": 0}
+        self.probes = 0
+
+    def prefill_chunk_into(self, slot, prompt, chunk_i, *, start=0, **kw):
+        return (int(prompt[0]) + 100 * self.param_version) % 997, False
+
+    def decode(self, **kw):
+        return ([1 + self.param_version] * self.n_slots,
+                [False] * self.n_slots)
+
+    def probe(self):
+        self.probes += 1
+
+    def set_param_version(self, v):
+        self.param_version = int(v)
+
+    def swap_params(self, params, *, draft_params=None, version=None):
+        self._params = params
+        self.param_version = (int(version) if version is not None
+                              else self.param_version + 1)
+        self.counters["param_swaps"] += 1
+        return self.param_version
+
+
+# ---------------------------------------------------------------------------
+# Publish transport: atomic manifest, digest, crash window, fallback walk
+# ---------------------------------------------------------------------------
+
+def _tree(k: float):
+    import jax.numpy as jnp
+
+    return {"w": jnp.arange(8.0) * k, "b": jnp.ones((3,)) * k}
+
+
+def test_publish_monotone_versions_and_crash_mid_publish(tmp_path):
+    d = str(tmp_path / "pub")
+    pub = ParamPublisher(d, keep=4)
+    assert pub.publish(2, _tree(1)) == 1
+    assert pub.publish(4, _tree(2)) == 2
+    m = read_manifest(d)
+    assert m["version"] == 2 and m["step"] == 4
+    assert m["history"]["1"]["step"] == 2
+
+    # crash in the WIDEST window (data durable, manifest not yet flipped):
+    # the previous version keeps serving, the attempt's dir is an orphan
+    plan = FaultPlan.parse("crash_in_publish@6")
+    from dtf_tpu.fault.inject import FaultHook
+
+    hook = FaultHook(plan, publisher=pub, emit=lambda line: None)
+    with pytest.raises(InjectedCrash):
+        pub.publish(6, _tree(3))
+    assert hook.fired
+    assert read_manifest(d)["version"] == 2
+    v, s, params = load_published(d)
+    assert (v, s) == (2, 4)
+    assert float(params["w"][1]) == 2.0
+
+    # the orphan's number is never reused (its bytes are the crashed
+    # attempt's) — by the live publisher AND by a restarted one
+    assert pub.publish(6, _tree(3)) == 4
+    assert ParamPublisher(d, keep=4).publish(8, _tree(5)) == 5
+    v, _, params = load_published(d)
+    assert v == 5 and float(params["w"][1]) == 5.0
+
+
+@pytest.mark.slow  # tier-1 budget: orbax round-trips; the fast tier's
+# crash test + the launcher chaos cover the guarded/explicit contract
+def test_publish_corrupt_guarded_walk_vs_explicit_no_fallback(tmp_path):
+    d = str(tmp_path / "pub")
+    pub = ParamPublisher(d)
+    pub.publish(1, _tree(1))
+    pub.publish(2, _tree(2))
+    corrupt_publish_version(d, 2, mode="garbage")
+    # latest: guarded walk WARNs past the corrupt newest version
+    v, _, params = load_published(d)
+    assert v == 1 and float(params["w"][1]) == 1.0
+    # explicit: the caller asked for exactly that version — no fallback
+    with pytest.raises(ValueError, match="digest"):
+        load_published(d, version=2)
+    # the watcher skips it once and REMEMBERS (no re-WARN loop), and the
+    # fleet keeps whatever it already serves
+    w = PublishWatcher(d, applied_version=1)
+    assert w.load_new() is None and w.skipped == {2}
+    assert w.poll() is None
+    # a fresh (uncorrupt) republish is picked up normally
+    pub.publish(3, _tree(3))
+    got = w.load_new()
+    assert got is not None and got[0] == 3
+
+
+# ---------------------------------------------------------------------------
+# Page epochs: stale-weight KV is unreachable, invalidation reclaims
+# ---------------------------------------------------------------------------
+
+def test_prefix_epoch_gates_lookup_and_invalidate_stale():
+    idx = PrefixIndex(4, 2, save_after=1)
+    a = idx.reserve((1, 2), None, epoch=0)
+    idx.reserve((1, 2, 3, 4), a, epoch=0)
+    h0 = idx.acquire((1, 2, 3, 4, 9), epoch=0)
+    assert h0 is not None
+    idx.release(h0)                   # unpin (slot-evict contract)
+    # the SAME tokens at a new param version: a miss by definition —
+    # the KV bytes were produced by different weights
+    assert idx.acquire((1, 2, 3, 4, 9), epoch=1) is None
+    assert idx.longest((1, 2, 9), epoch=1) == (0, None)
+    # re-caching the same tokens at the new epoch is NOT a duplicate
+    b = idx.reserve((1, 2), None, epoch=1)
+    assert b is not None and b.epoch == 1
+    # a chain can never cross versions
+    with pytest.raises(ValueError, match="mix KV"):
+        idx.reserve((1, 2, 3, 4), b, epoch=0)
+    # eager reclaim once the fleet converged: epoch-0 chain (parent AND
+    # child — the fixpoint cascade) frees; the epoch-1 entry survives
+    freed = idx.invalidate_stale(1)
+    assert freed == 2
+    assert idx.acquire((1, 2, 9), epoch=0) is None
+    assert idx.acquire((1, 2, 9), epoch=1) is not None
+    assert idx.n_entries == 1
+    # sightings are per-epoch too: epoch-0 traffic must not pre-qualify
+    # the save-admission gate for epoch 1
+    idx2 = PrefixIndex(4, 2, save_after=2)
+    assert idx2.save_eligible((7, 8), 0, 1, epoch=0) == 0
+    assert idx2.save_eligible((7, 8), 0, 1, epoch=1) == 0   # not 1
+    assert idx2.save_eligible((7, 8), 0, 1, epoch=1) == 1
+
+
+# ---------------------------------------------------------------------------
+# Router rolling swap on fakes: canary gate, rollbacks, skew tripwire
+# ---------------------------------------------------------------------------
+
+def _fake_fleet(clk, n=3, **hc):
+    cfg = dict(min_slow_s=1.0, wedge_s=5.0, probation_delay_s=1000.0)
+    cfg.update(hc)
+    return Router([_FakeEngine() for _ in range(n)], clock=clk,
+                  health=HealthConfig(**cfg))
+
+
+def test_rolling_swap_stamps_versions_and_never_stops_serving():
+    clk = _Clock()
+    r = _fake_fleet(clk)
+    rids = [r.submit(Request(prompt=[i + 1], max_new=6)) for i in range(5)]
+    for _ in range(2):
+        r.tick()
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=3))
+    assert r.swap_in_progress
+    r.drain()
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_version"] == 1.0 and st["router_swaps"] == 1.0
+    assert st["router_swap_rollbacks"] == 0.0
+    assert all(st[f"replica{i}_version"] == 1.0 for i in range(3))
+    # zero failed requests across the swap, every record version-stamped
+    for rid in rids:
+        p = r.poll(rid)
+        assert p["status"] == "done" and p["version"] in (0, 1)
+    # every replica was probed on re-admission (same compiled decode)
+    assert all(s.engine.probes >= 1 for s in r.schedulers)
+    # post-swap traffic stamps the new version
+    rid = r.submit(Request(prompt=[9], max_new=3))
+    r.drain()
+    assert r.poll(rid)["version"] == 1
+    # the heartbeat/postmortem panels carry the versions
+    pm = r.postmortem_state()["router"]
+    assert pm["version"] == 1 and pm["replica_versions"] == [1, 1, 1]
+    assert pm["last_swap"]["outcome"] == "done"
+
+
+def test_canary_health_breach_rolls_back_fleet_wide():
+    clk = _Clock()
+    r = _fake_fleet(clk, n=2)
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=4))
+    r.tick()                               # canary (replica 0) swapped
+    canary = 0
+    eng = r.schedulers[canary].engine
+    orig = eng.decode
+
+    def wedged(**kw):
+        clk.advance(9.0)                   # past the wedge bar
+        return orig(**kw)
+
+    eng.decode = wedged
+    rids = [r.submit(Request(prompt=[i + 1], max_new=4)) for i in range(4)]
+    r.drain()
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_swap_rollbacks"] == 1.0 and st["router_swaps"] == 0.0
+    assert st["router_version"] == 0.0
+    assert {st[f"replica{i}_version"] for i in range(2)} == {0.0}
+    for rid in rids:                       # the fleet never stopped
+        assert r.poll(rid)["status"] == "done"
+    assert "canary" in r._last_swap["cause"]
+
+
+def test_canary_slo_breach_rolls_back():
+    clk = _Clock()
+    r = Router([_FakeEngine(), _FakeEngine()], clock=clk,
+               health=HealthConfig(min_slow_s=1000.0, wedge_s=1000.0),
+               ttft_slo_s=1.0)
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=6,
+                                             slo_floor=0.9,
+                                             slo_min_samples=1))
+    r.tick()                               # canary swapped
+    rids = [r.submit(Request(prompt=[i + 1], max_new=3)) for i in range(4)]
+    clk.advance(5.0)                       # every first token now > SLO
+    r.drain()
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_swap_rollbacks"] == 1.0
+    assert st["router_version"] == 0.0
+    assert "SLO" in r._last_swap["cause"]
+    for rid in rids:
+        assert r.poll(rid)["status"] == "done"
+
+
+def test_probe_failure_after_swap_rolls_that_replica_back_too():
+    """A replica whose POST-swap probe raises already took the new
+    weights — the rollback must include it (it is marked swapped before
+    the probe), or the fleet would be left permanently on two versions
+    with the failed replica still routable."""
+    clk = _Clock()
+    r = _fake_fleet(clk, n=3)
+    eng = r.schedulers[2].engine
+
+    def bad_probe():
+        if eng.param_version == 1:      # wedged exactly once, post-swap
+            raise RuntimeError("probe wedged after the weights flipped")
+
+    eng.probe = bad_probe
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=1))
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_swap_rollbacks"] == 1.0
+    assert {st[f"replica{i}_version"] for i in range(3)} == {0.0}, st
+    rid = r.submit(Request(prompt=[4], max_new=2))
+    r.drain()
+    assert r.poll(rid)["status"] == "done"
+
+
+def test_failed_rollback_replica_repaired_before_readmission():
+    """A replica whose REVERSE swap fails during a rollback holds the
+    version the canary gate just rejected: it must stay unroutable (a
+    version-blind probation probe would re-admit it serving blacklisted
+    weights) until the version repair re-aligns it with the fleet."""
+    clk = _Clock()
+    r = _fake_fleet(clk, n=2, probation_delay_s=50.0)
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=4))
+    r.tick()                              # canary (replica 0) swapped
+    eng = r.schedulers[0].engine
+    orig_swap = eng.swap_params
+    fails = [1]
+
+    def flaky_swap(params, **kw):
+        if fails[0] and kw.get("version") == 0:   # the REVERSE swap
+            fails[0] -= 1
+            raise RuntimeError("reverse swap wedged")
+        return orig_swap(params, **kw)
+
+    eng.swap_params = flaky_swap
+    orig_decode = eng.decode
+
+    def wedged(**kw):                     # breach the canary gate
+        clk.advance(9.0)
+        return orig_decode(**kw)
+
+    eng.decode = wedged
+    rids = [r.submit(Request(prompt=[i + 1], max_new=3)) for i in range(4)]
+    r.drain()
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_swap_rollbacks"] == 1.0
+    assert st["replica0_version"] == 1.0      # stuck on rejected weights
+    pm = r.postmortem_state()["router"]
+    assert pm["version_repair_pending"] == [0]
+    # a stuck replica must not disable the fleet: traffic completes on
+    # the survivor, stamped with the COMMITTED (old) version only
+    for rid in rids:
+        assert r.poll(rid)["status"] == "done"
+    rid = r.submit(Request(prompt=[7], max_new=2))
+    r.drain()
+    assert r.poll(rid)["version"] == 0
+    # past the probation delay the REPAIR lands first (the wedge and the
+    # flaky swap are both cleared) — the fleet converges on one version
+    eng.decode = orig_decode
+    clk.advance(60.0)
+    for _ in range(4):
+        r.tick()
+    st = r.stats()
+    assert {st[f"replica{i}_version"] for i in range(2)} == {0.0}, st
+    assert r.postmortem_state()["router"]["version_repair_pending"] == []
+
+
+def test_forward_swap_clears_pending_repair():
+    """A replica awaiting version repair that a NEWER rolling swap
+    successfully swaps forward is on the target version — the stale
+    repair payload must be discarded, or a later retry would revert it
+    to rolled-back weights and split the fleet permanently."""
+    clk = _Clock()
+    r = _fake_fleet(clk, n=2, probation_delay_s=50.0)
+    r.schedulers[1].engine.param_version = 1        # stuck post-rollback
+    r._version_repair[1] = ({"w": 0}, None, 0)
+    r.start_swap({"w": 3}, version=2, config=SwapConfig(canary_ticks=1))
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_version"] == 2.0
+    assert {st[f"replica{i}_version"] for i in range(2)} == {2.0}
+    assert r.postmortem_state()["router"]["version_repair_pending"] == []
+    for _ in range(3):                              # nothing reverts later
+        r.tick()
+    assert {s.engine.param_version for s in r.schedulers} == {2}
+
+
+def test_repair_retries_are_backed_off_without_health():
+    """With no HealthTracker there is no quarantine to pace repair
+    retries: the tick backoff must keep a still-broken engine from
+    paying full-tree validation + placement (and a WARN) every tick."""
+    r = Router([_FakeEngine(), _FakeEngine()], clock=_Clock(),
+               health=False)
+    eng = r.schedulers[1].engine
+    calls = [0]
+
+    def bad_swap(params, **kw):
+        calls[0] += 1
+        raise RuntimeError("still broken")
+
+    eng.swap_params = bad_swap
+    r._version_repair[1] = ({"w": 0}, None, 0)
+    for _ in range(64):
+        r.tick()
+    assert 0 < calls[0] <= 8, calls[0]      # ~log2(64), not 64
+    assert not r._routable(1)               # still out of traffic
+
+
+def test_canary_slo_gate_survives_bounded_ttft_deque():
+    """The canary SLO gate measures samples-since-swap against the
+    scheduler's MONOTONE ttft counter: with the bounded TTFT deque
+    already full before the swap, a len()-based mark would never see a
+    post-swap sample again and a bad version would roll fleet-wide."""
+    clk = _Clock()
+    r = Router([_FakeEngine(), _FakeEngine()], clock=clk,
+               health=HealthConfig(min_slow_s=1000.0, wedge_s=1000.0),
+               ttft_slo_s=1.0, completed_cap=4)
+    for i in range(10):                 # saturate both replicas' deques
+        r.submit(Request(prompt=[i + 1], max_new=1))
+    r.drain()
+    assert all(len(s._ttfts) == 4 and s.ttft_count >= 4
+               for s in r.schedulers)
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=6,
+                                             slo_floor=0.9,
+                                             slo_min_samples=1))
+    r.tick()                            # canary swapped
+    rids = [r.submit(Request(prompt=[i + 1], max_new=2)) for i in range(4)]
+    clk.advance(5.0)                    # post-swap first tokens > SLO
+    r.drain()
+    r.finish_swap()
+    st = r.stats()
+    assert st["router_swap_rollbacks"] == 1.0, st
+    assert st["router_version"] == 0.0
+    for rid in rids:
+        assert r.poll(rid)["status"] == "done"
+
+
+def test_wedge_in_swap_rolls_partial_fleet_back_to_one_version():
+    clk = _Clock()
+    r = _fake_fleet(clk, n=3)
+    # replica 2's first swap call wedges then raises mid-rolling-swap
+    plan = ServeFaultPlan.parse("wedge_in_swap@0:replica=2")
+    state = install_serve_fault(plan, r, sleep=clk.advance, wedge_s=0.5,
+                                emit=lambda line: None)
+    r.start_swap({"w": 2}, config=SwapConfig(canary_ticks=1))
+    rids = [r.submit(Request(prompt=[i + 1], max_new=4)) for i in range(4)]
+    r.drain()
+    r.finish_swap()
+    assert state.fired
+    st = r.stats()
+    assert st["router_swap_rollbacks"] == 1.0
+    # ONE version fleet-wide after the partial rollback — the old one
+    assert {st[f"replica{i}_version"] for i in range(3)} == {0.0}
+    assert st["router_version"] == 0.0
+    for rid in rids:
+        assert r.poll(rid)["status"] == "done"
+    # a later swap (fault is one-shot) succeeds end to end
+    r.start_swap({"w": 3}, config=SwapConfig(canary_ticks=1))
+    r.finish_swap()
+    assert r.stats()["router_version"] == 1.0
+
+
+def test_version_skew_tripwire_warns_once_rearmed(caplog):
+    r = Router([_FakeEngine(), _FakeEngine()], clock=_Clock(),
+               health=HealthConfig())
+    with caplog.at_level(logging.WARNING, logger="dtf_tpu"):
+        r.stats()
+        assert not [m for m in caplog.messages if "skew" in m]
+        r.schedulers[1].engine.param_version = 7      # diverge
+        r.stats()
+        r.stats()                                     # sustained: ONE warn
+        assert len([m for m in caplog.messages
+                    if "spans param versions" in m]) == 1
+        r.schedulers[0].engine.param_version = 7      # converge: re-arm
+        r.stats()
+        r.schedulers[1].engine.param_version = 8      # diverge again
+        r.stats()
+        assert len([m for m in caplog.messages
+                    if "spans param versions" in m]) == 2
+    # mid-swap divergence is EXPECTED and must not trip the wire
+    r.schedulers[1].engine.param_version = 7
+    r._swap = {"version": 9}
+    with caplog.at_level(logging.WARNING, logger="dtf_tpu"):
+        caplog.clear()
+        r._skew_check()
+        assert not caplog.messages
+    r._swap = None
+
+
+def test_start_swap_validation():
+    r = Router([_FakeEngine(), _FakeEngine()], clock=_Clock(),
+               health=HealthConfig())
+    r.stamp_version(5)
+    with pytest.raises(ValueError, match="monotone"):
+        r.start_swap({"w": 1}, version=5)
+    single = Router([_FakeEngine()])
+    with pytest.raises(ValueError, match=">= 2 replicas"):
+        single.start_swap({"w": 1})
+    r.start_swap({"w": 1})
+    with pytest.raises(RuntimeError, match="already in progress"):
+        r.start_swap({"w": 2})
+    with pytest.raises(ValueError, match="canary_ticks"):
+        SwapConfig(canary_ticks=0)
+    with pytest.raises(ValueError, match="slo_floor"):
+        SwapConfig(slo_floor=1.5)
+    # verb family routing: the swap verbs are SERVE verbs
+    env = {"DTF_FAULT_INJECT": "wedge_in_swap@0:replica=1"}
+    assert FaultPlan.from_env(env=env) is None
+    assert ServeFaultPlan.from_env(env=env).kind == "wedge_in_swap"
+    env = {"DTF_FAULT_INJECT": "crash_in_publish@4"}
+    assert FaultPlan.from_env(env=env).kind == "crash_in_publish"
+    assert ServeFaultPlan.from_env(env=env) is None
+
+
+# ---------------------------------------------------------------------------
+# Real tiny engines: swap_params pinned + bitwise, the tier-1 swap smoke
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def gpt_setup():
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.models import gpt
+
+    cfg = gpt.GPTConfig.tiny(dtype=jnp.float32)
+    model = gpt.GPT(dataclasses.replace(cfg, decode_len=48))
+    p0 = model.init(jax.random.PRNGKey(0),
+                    jnp.zeros((1, 1), jnp.int32))["params"]
+    p1 = model.init(jax.random.PRNGKey(1),
+                    jnp.zeros((1, 1), jnp.int32))["params"]
+    return cfg, model, p0, p1
+
+
+def _offline(model, params, req):
+    import jax
+    import jax.numpy as jnp
+
+    from dtf_tpu.models import gpt
+
+    out = gpt.generate(
+        model, params, jnp.asarray([req["prompt"]], jnp.int32),
+        req["max_new"], rng=jax.random.PRNGKey(req.get("seed", 0)),
+        temperature=req.get("temperature", 0.0))
+    return np.asarray(out)[0, len(req["prompt"]):].tolist()
+
+
+def test_engine_swap_params_bitwise_and_trace_counts_pinned(gpt_setup):
+    from dtf_tpu.serve import DecodeEngine, ServeClient
+
+    cfg, model, p0, p1 = gpt_setup
+    eng = DecodeEngine(cfg, p0, n_slots=2, max_len=48, prefill_chunk=5)
+    client = ServeClient(eng)
+    req = dict(prompt=[3, 1, 4, 1, 5], max_new=6, seed=7,
+               temperature=0.8)
+    assert client.result(client.submit(**req)) == _offline(model, p0, req)
+    # drained → swap → the SAME compiled programs serve the new weights
+    eng.swap_params(p1, version=1)
+    assert eng.param_version == 1
+    assert client.result(client.submit(**req)) == _offline(model, p1, req)
+    greedy = dict(prompt=[2, 7, 2], max_new=5)
+    assert (client.result(client.submit(**greedy))
+            == _offline(model, p1, greedy))
+    assert eng.trace_counts == {"prefill": 1, "decode": 1}
+    assert eng.counters["param_swaps"] == 1
+    # a tree that is NOT drop-in fails loudly naming the problem
+    bad = dict(p1)
+    bad.pop(next(iter(p1)))
+    with pytest.raises(ValueError, match="tree structure"):
+        eng.swap_params(bad)
+    import jax
+
+    wrong = jax.tree.map(lambda x: x[..., None], p1)
+    with pytest.raises(ValueError, match="leaf"):
+        eng.swap_params(wrong)
+
+
+@pytest.mark.slow  # tier-1 budget: the smoke stamps versions fast-tier;
+# the spanning-request replay rides the slow pyramid with the chaos fleet
+def test_request_spanning_swap_completes_on_exactly_one_version(gpt_setup):
+    cfg, model, p0, p1 = gpt_setup
+    router = Router.build(cfg, p0, n_replicas=2, n_slots=2, max_len=48,
+                          prefill_chunk=5, clock=_Clock(),
+                          health=HealthConfig())
+    req = dict(prompt=[5, 3, 1], max_new=8, seed=11, temperature=0.6)
+    rid = router.submit(Request(**req))
+    for _ in range(3):
+        router.tick()            # tokens already in flight
+    router.start_swap(p1, version=1, config=SwapConfig(canary_ticks=1))
+    router.drain()
+    router.finish_swap()
+    p = router.poll(rid)
+    assert p["status"] == "done" and p["version"] in (0, 1)
+    # the whole stream came from the stamped version's weights — a
+    # request spanning the boundary replays WHOLE on one version
+    params_of = {0: p0, 1: p1}
+    assert p["tokens"] == _offline(model, params_of[p["version"]], req)
+    assert router.trace_counts() == [{"prefill": 1, "decode": 1}] * 2
+
+
+@pytest.mark.slow  # tier-1 budget: the epoch gate is unit-tested fast
+# (test_prefix_epoch_gates_*); this device-level proof rides slow with
+# the spec+shared-pages chaos fleet
+def test_pages_never_serve_stale_weight_kv(gpt_setup):
+    cfg, model, p0, p1 = gpt_setup
+    router = Router.build(cfg, p0, n_replicas=2, n_slots=2, max_len=48,
+                          prefill_chunk=4, kv_page_size=4, prefix_pages=8,
+                          page_save_after=1, clock=_Clock(),
+                          health=HealthConfig())
+    req = dict(prompt=list(range(1, 13)), max_new=4, seed=3)
+    # warm the stem pages at version 0 on BOTH replicas
+    for s in router.schedulers:
+        warm = s.submit(Request(**req))
+        s.run_until_idle()
+        assert s.poll(warm)["status"] == "done"
+    # the v0 pages ARE reachable before the swap (same stem → gather)
+    probe = router.schedulers[1].submit(Request(**req))
+    router.schedulers[1].run_until_idle()
+    assert router.schedulers[1].poll(probe)["tokens"] \
+        == _offline(model, p0, req)
+    hits0 = sum(s.engine.counters["pages_loaded"]
+                for s in router.schedulers)
+    assert hits0 >= 2
+    router.start_swap(p1, version=1, config=SwapConfig(canary_ticks=1))
+    router.finish_swap()
+    # same stem, new weights: the v0 pages are UNREACHABLE (epoch gate) —
+    # full prefill, and the tokens are the new version's, bitwise
+    rid = router.submit(Request(**req))
+    router.drain()
+    p = router.poll(rid)
+    assert p["version"] == 1
+    assert p["tokens"] == _offline(model, p1, req)
+    assert sum(s.engine.counters["pages_loaded"]
+               for s in router.schedulers) == hits0   # no stale gather
+    for s in router.schedulers:
+        assert s.engine.prefix_stats()["pinned"] == 0
+    # commit reclaimed the v0 pool bytes eagerly
+    stats = router.schedulers[0].engine.prefix_stats()
+    assert stats["pages"] <= 3          # only the re-saved v1 stem remains
+
+
+def test_swap_smoke_trainer_publishes_fleet_rolls_twice(gpt_setup,
+                                                        tmp_path):
+    """THE tier-1 swap smoke (ISSUE 14 CI satellite): a tiny real Trainer
+    publishes 2 versions through PublishHook; a 2-replica fleet starts on
+    the built weights and ROLLS twice to the published versions while
+    serving — zero requests end shed/timeout/error, every completed
+    record is version-stamped, and post-swap tokens are bitwise identical
+    to a fresh fleet restored from the same published version."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dtf_tpu.core import train as tr
+    from dtf_tpu.core.mesh import MeshConfig, make_mesh
+    from dtf_tpu.hooks import PublishHook, StopAtStepHook
+    from dtf_tpu.loop import Trainer
+
+    cfg, model, p0, _ = gpt_setup
+    pub_dir = str(tmp_path / "publish")
+
+    # --- the trainer: a cheap deterministic loss over the REAL GPT tree
+    # (every leaf moves each step; the serving fleet consumes the tree)
+    def _init(rng):
+        del rng
+        return {"params": p0}
+
+    def _loss(params, extra, batch, rng):
+        del rng
+        s = sum(jnp.mean(jnp.square(x.astype(jnp.float32)))
+                for x in jax.tree.leaves(params))
+        return s * batch["x"][0], tr.LossAux(extra=extra, metrics={})
+
+    mesh = make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+    tx = optax.sgd(0.05)
+    state, shardings = tr.create_train_state(
+        _init, tx, jax.random.PRNGKey(0), mesh)
+    step = tr.make_train_step(_loss, tx, mesh, shardings)
+    publisher = ParamPublisher(pub_dir)
+
+    def train_to(state, stop):
+        trainer = Trainer(step, mesh,
+                          hooks=[PublishHook(publisher, every_n=2),
+                                 StopAtStepHook(stop)])
+        batches = ({"x": np.ones((1,), np.float32)} for _ in iter(int, 1))
+        return trainer.fit(state, batches, max_steps=stop)
+
+    # --- the fleet starts on the v0 (built) weights and serves while the
+    # trainer publishes; each new version ROLLS across the live fleet
+    router = Router.build(cfg, p0, n_replicas=2, n_slots=2, max_len=48,
+                          prefill_chunk=5, clock=_Clock(),
+                          health=HealthConfig())
+    watcher = PublishWatcher(pub_dir, applied_version=0)
+    swap_cfg = SwapConfig(canary_ticks=2)
+    rng = np.random.default_rng(5)
+    reqs = [dict(prompt=rng.integers(0, 128,
+                                     int(rng.integers(1, 10))).tolist(),
+                 max_new=int(rng.integers(2, 7)),
+                 temperature=0.0 if i % 2 else 0.7, seed=60 + i)
+            for i in range(8)]
+    rids = []
+    rolled = 0
+    for i, r in enumerate(reqs):
+        rids.append(router.submit(Request(**r)))
+        router.tick()
+        if i in (1, 4):                           # publish → poll → roll
+            state = train_to(state, 2 * (rolled + 1))
+            assert read_manifest(pub_dir)["version"] == rolled + 1
+            assert router.maybe_swap_published(
+                watcher, config=swap_cfg) == rolled + 1
+            rolled += 1
+            router.finish_swap()
+    router.drain()
+    st = router.stats()
+    assert st["router_swaps"] == 2.0 and st["router_swap_rollbacks"] == 0.0
+    assert st["router_version"] == 2.0
+    assert watcher.applied_version == 2
+    # zero failed requests attributable to the swaps — all done, stamped
+    versions = []
+    for rid in rids:
+        p = router.poll(rid)
+        assert p["status"] == "done", p
+        versions.append(p["version"])
+    assert set(versions) <= {0, 1, 2}
+    assert versions[-1] == 2                      # last request post-roll
+    assert router.trace_counts() == [{"prefill": 1, "decode": 1}] * 2
+
+    # bitwise: a FRESH fleet restored from published v2 serves identical
+    # tokens for the post-swap requests (swapped fleet == restored fleet)
+    v2, _, params2 = load_published(pub_dir, version=2)
+    fresh = Router.build(cfg, params2, n_replicas=2, n_slots=2, max_len=48,
+                         prefill_chunk=5, clock=_Clock(),
+                         health=HealthConfig())
+    fresh.stamp_version(v2)
+    for r, rid, v in zip(reqs, rids, versions):
+        if v != 2:
+            continue
+        frid = fresh.submit(Request(**r))
+        fresh.drain()
+        assert fresh.result(frid) == router.poll(rid)["tokens"], r
