@@ -207,7 +207,7 @@ def lint_file(path: str) -> list[str]:
             "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
         problems += _hotpath_readbacks(tree, path, noqa, src)
 
-    # ---- backend imports fenced out of telemetry/, tune/ AND fault/ ----
+    # ---- backend imports fenced out of telemetry/tune/fault/stream ----
     # telemetry: reports parse traces on chipless machines. tune: the
     # bench_tune parent imports the package BEFORE probing the backend
     # (dead-tunnel rc-0 contract) — a module-level jax import in either
@@ -221,7 +221,10 @@ def lint_file(path: str) -> list[str]:
                       "import hangs the dead-tunnel rc-0 path"),
                      ("fault", "the run controller supervises a possibly-"
                       "wedged backend from a clean process and must "
-                      "never import what it has to outlive")):
+                      "never import what it has to outlive"),
+                     ("stream", "the mixture stream is pure host IO "
+                      "whose producer thread and bench row must run — "
+                      "and be testable — with no backend present")):
         in_pkg = (pkg in dirs if anchored
                   else bool(dirs) and dirs[-1] == pkg)
         if in_pkg:

@@ -97,6 +97,11 @@ class Checkpointer:
         #: the step the last guarded latest-step restore actually loaded
         #: (may be OLDER than latest when the newest step was unreadable)
         self._last_restored_step: int | None = None
+        #: extra-item providers: ``{name: fn(step) -> JSON-able value}``,
+        #: folded into every :meth:`save` next to the state/params items
+        #: (the streaming tier registers ``stream`` here so the SIGTERM
+        #: ``save_durable`` path cannot forget the stream state).
+        self._extra_providers: dict = {}
 
     @property
     def directory(self) -> str:
@@ -112,7 +117,29 @@ class Checkpointer:
         not latest. None before any guarded restore."""
         return self._last_restored_step
 
-    def save(self, step: int, state: PyTree, *, force: bool = False) -> bool:
+    def add_extra_provider(self, name: str, fn) -> None:
+        """Register ``fn(step) -> JSON-able value`` as a standing extra
+        item: every subsequent :meth:`save`/:meth:`save_durable` includes
+        its value for the step being saved (provider registration beats
+        threading an ``extra_items`` through every save call site — the
+        preemption path especially must not be forgettable)."""
+        if name in ("state", "params"):
+            raise ValueError(f"extra item name {name!r} is reserved")
+        self._extra_providers[name] = fn
+
+    def _extra_args(self, step: int, extra_items: dict | None) -> dict:
+        items = {name: fn(step) for name, fn in self._extra_providers.items()}
+        if extra_items:
+            for name in extra_items:
+                if name in ("state", "params"):
+                    raise ValueError(
+                        f"extra item name {name!r} is reserved")
+            items.update(extra_items)
+        return {name: ocp.args.JsonSave(value)
+                for name, value in items.items()}
+
+    def save(self, step: int, state: PyTree, *, force: bool = False,
+             extra_items: dict | None = None) -> bool:
         """Async sharded save. Returns True if a save was actually queued.
 
         When ``state`` carries a params subtree (TrainState attribute or
@@ -121,6 +148,13 @@ class Checkpointer:
         weights instead of reading ~3x params bytes of dead opt_state
         (:meth:`restore_params`). Anything else keeps the legacy
         single-item layout.
+
+        ``extra_items`` — ``{name: JSON-able value}`` saved as additional
+        Composite members next to the state (merged over the registered
+        :meth:`add_extra_provider` values); read back by
+        :meth:`restore_extra`, which treats their absence in an older
+        checkpoint as a WARN, never a raise. The streaming data tier's
+        ``stream`` StreamState is the motivating member (docs/DATA.md).
 
         Deliberate cost: the params bytes are stored twice (~25% more per
         Adam checkpoint). The alternative — state-minus-params plus
@@ -131,16 +165,23 @@ class Checkpointer:
         step = int(step)
         if step in self._mgr.all_steps():
             return False
+        extras = self._extra_args(step, extra_items)
         params = getattr(state, "params", None)
         if params is None and isinstance(state, dict):
             params = state.get("params")
         if params is None:
-            return self._mgr.save(step, args=ocp.args.StandardSave(state),
-                                  force=force)
+            if not extras:
+                return self._mgr.save(
+                    step, args=ocp.args.StandardSave(state), force=force)
+            return self._mgr.save(
+                step, args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state), **extras),
+                force=force)
         return self._mgr.save(
             step,
             args=ocp.args.Composite(state=ocp.args.StandardSave(state),
-                                    params=ocp.args.StandardSave(params)),
+                                    params=ocp.args.StandardSave(params),
+                                    **extras),
             force=force)
 
     def save_params(self, step: int, params: PyTree, *,
@@ -159,7 +200,8 @@ class Checkpointer:
             force=force)
 
     def save_durable(self, step: int, state: PyTree, *, retries: int = 2,
-                     backoff_s: float = 0.25, sleep=None) -> bool:
+                     backoff_s: float = 0.25, sleep=None,
+                     extra_items: dict | None = None) -> bool:
         """Force-save ``step`` and block until durable, retrying transient
         failures with exponential backoff.
 
@@ -173,7 +215,7 @@ class Checkpointer:
         sleep = sleep or time.sleep
         for attempt in range(retries + 1):
             try:
-                self.save(step, state, force=True)
+                self.save(step, state, force=True, extra_items=extra_items)
                 self.wait()
                 return True
             except Exception as e:  # noqa: BLE001 — any failure class
@@ -374,6 +416,40 @@ class Checkpointer:
             f"(tried {steps}) — corrupt files, or a restore failure this "
             f"guard didn't recognize; last error: "
             f"{type(last_err).__name__}: {last_err}")
+
+    def restore_extra(self, name: str, step: int | None = None):
+        """One extra Composite item (see :meth:`save` ``extra_items``), or
+        None — with a WARN — when ``step`` predates the item (a legacy
+        checkpoint must restore WITHOUT its stream state, not raise: the
+        model state is intact, and the stream can rebuild from its spec).
+        ``step=None`` reads the step the last guarded restore loaded (the
+        consistent pair for restore-if-exists), falling back to latest.
+        """
+        if step is None:
+            step = (self._last_restored_step
+                    if self._last_restored_step is not None
+                    else self._mgr.latest_step())
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}")
+        step = int(step)
+        if not self._has_item(step, name):
+            log.warning(
+                "checkpoint step %d at %s has no %r item (saved before "
+                "this extra existed); restoring without it", step,
+                self.directory, name)
+            return None
+        try:
+            return self._mgr.restore(
+                step, args=ocp.args.Composite(
+                    **{name: ocp.args.JsonRestore()}))[name]
+        except Exception as e:  # noqa: BLE001 — an unreadable extra must
+            # not take down a restore whose model state is fine
+            log.warning(
+                "checkpoint step %d at %s: extra item %r is unreadable "
+                "(%s: %.200s); restoring without it", step, self.directory,
+                name, type(e).__name__, e)
+            return None
 
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:
         """(state, restored_step) — state unchanged if nothing on disk.

@@ -88,6 +88,13 @@ def define_train_flags(batch_size=64, learning_rate=0.01, train_steps=1000,
     flags.DEFINE_float("weight_decay", -1.0, "weight decay for "
                        "adamw/lamb overrides (-1 = optimizer default)")
     flags.DEFINE_integer("seed", 0, "PRNG seed")
+    flags.DEFINE_integer("prefetch_depth", 2, "device-input prefetch "
+                         "depth: batch N+1's host->device transfer "
+                         "dispatches while step N computes "
+                         "(dtf_tpu/data/prefetch.py double buffer; 1 = "
+                         "off). With a mixture stream this also sizes "
+                         "the bounded background producer queue "
+                         "(docs/DATA.md)")
     flags.DEFINE_integer("profile_steps", 0, "capture an XPlane profiler "
                          "trace spanning this many steps (0 = off); written "
                          "to <logdir>/profile")

@@ -1,1 +1,3 @@
-"""Input pipeline: per-host sharded batches for the five BASELINE workloads."""
+"""Input pipeline: per-host sharded batches for the five BASELINE workloads,
+plus the streaming data tier (``dtf_tpu/data/stream`` — weighted
+multi-dataset mixture with deterministic checkpointed resume; docs/DATA.md)."""

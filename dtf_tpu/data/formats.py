@@ -179,6 +179,48 @@ class TokenBinData:
                 and path.endswith(".bin")) or \
             os.path.exists(os.path.join(path, f"{split}.bin"))
 
+    #: SeedSequence salt separating the per-EXAMPLE stream (:meth:`example`)
+    #: from the per-batch stream (:meth:`batch`) — the two must never
+    #: collide or a mixture stream and a plain loader at the same seed
+    #: would draw correlated windows.
+    EXAMPLE_SALT = 0x5EED_0001
+
+    def example(self, index: int) -> Batch:
+        """One example addressed by a GLOBAL example index — the mixture
+        stream's cursor hook (``dtf_tpu/data/stream``).
+
+        Unlike :meth:`batch` (keyed ``[seed, step, host]``: a host-local
+        batch), the draw here is keyed ``[seed, EXAMPLE_SALT, index]`` and
+        is host-free, so example ``i`` is the same bytes no matter which
+        host materializes it — the property that lets a shrink-resume
+        re-partition per-host cursors without changing the realized global
+        batch sequence. Rows are unbatched (``[seq_len]`` arrays).
+        """
+        r = np.random.default_rng(
+            np.random.SeedSequence([self.seed, self.EXAMPLE_SALT,
+                                    int(index)]))
+        s = int(r.integers(0, len(self.tokens) - self.seq_len - 1))
+        win = np.asarray(self.tokens[s:s + self.seq_len + 1]).astype(np.int32)
+        if self.mode == "clm":
+            return {"input_ids": win[:-1], "labels": win[1:]}
+        return self._mlm_mask(r, win[:-1])
+
+    def _mlm_mask(self, r: np.random.Generator, ids: np.ndarray) -> Batch:
+        """Dynamic masking, the BERT 80/10/10 recipe — ONE implementation
+        for the per-batch and per-example streams (of the 15% selected
+        positions: 80% → [MASK], 10% → random token, 10% unchanged; all
+        still predicted). ``ids`` may be [B, T] or [T]."""
+        mask_pos = r.random(ids.shape) < 0.15
+        labels = np.where(mask_pos, ids, -100).astype(np.int32)
+        u = r.random(ids.shape)
+        rand_tok = r.integers(0, self.vocab_for_random, ids.shape)
+        masked = np.where(mask_pos & (u < 0.8), self.mask_token,
+                          np.where(mask_pos & (u < 0.9), rand_tok, ids))
+        return {"input_ids": masked.astype(np.int32),
+                "segment_ids": np.zeros_like(ids),
+                "attention_mask": np.ones_like(ids),
+                "mlm_labels": labels}
+
     def batch(self, step: int) -> Batch:
         r = np.random.default_rng(
             np.random.SeedSequence([self.seed, step, self.host]))
@@ -189,19 +231,7 @@ class TokenBinData:
         ]).astype(np.int32)
         if self.mode == "clm":
             return {"input_ids": win[:, :-1], "labels": win[:, 1:]}
-        ids = win[:, :-1]
-        mask_pos = r.random(ids.shape) < 0.15
-        labels = np.where(mask_pos, ids, -100).astype(np.int32)
-        # BERT 80/10/10: of the selected positions, 80% become [MASK], 10%
-        # a random token, 10% stay unchanged (all still predicted).
-        u = r.random(ids.shape)
-        rand_tok = r.integers(0, self.vocab_for_random, ids.shape)
-        masked = np.where(mask_pos & (u < 0.8), self.mask_token,
-                          np.where(mask_pos & (u < 0.9), rand_tok, ids))
-        return {"input_ids": masked.astype(np.int32),
-                "segment_ids": np.zeros_like(ids),
-                "attention_mask": np.ones_like(ids),
-                "mlm_labels": labels}
+        return self._mlm_mask(r, win[:, :-1])
 
     def __iter__(self) -> Iterator[Batch]:
         step = 0

@@ -111,6 +111,11 @@ class NativeIdxData:
                 "(bad IDX, mismatched item counts, or batch > shard)")
         self.item_size = lib.dtfio_item_size(self._h)
         self.num_items = lib.dtfio_num_items(self._h)
+        #: explicit offset cursor (the streaming-tier resume hook): the
+        #: native shuffle is deterministic in (seed, host), so "batches
+        #: consumed" fully addresses the stream position — :meth:`seek`
+        #: replays to it after a restore.
+        self.batches_consumed = 0
 
     def next_batch(self) -> dict:
         if not self._h:
@@ -121,7 +126,24 @@ class NativeIdxData:
             self._h,
             images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        self.batches_consumed += 1
         return {"image": images, "label": labels}
+
+    def seek(self, n_batches: int) -> None:
+        """Advance the cursor to ``n_batches`` consumed (resume-by-replay).
+
+        The native library exposes no random access — its shuffle state
+        lives inside the prefetch thread — but the stream IS deterministic,
+        so a fresh loader replays ``n`` draws to land exactly where the
+        checkpointed one stood. Cost is host-side assembly only (no device
+        work); restore-time, not per-step. Rewinding needs a fresh loader.
+        """
+        if n_batches < self.batches_consumed:
+            raise ValueError(
+                f"cannot seek backwards ({self.batches_consumed} -> "
+                f"{n_batches}); construct a fresh loader")
+        while self.batches_consumed < n_batches:
+            self.next_batch()
 
     def __iter__(self) -> Iterator[dict]:
         while True:

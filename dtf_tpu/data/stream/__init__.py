@@ -1,0 +1,32 @@
+"""Streaming data tier: multi-dataset weighted mixture with deterministic
+checkpointed resume (ISSUE 15; docs/DATA.md).
+
+The trainer-side input subsystem the online-learning loop needed: several
+corpora mixed at requested weights by a counter-based sampler, per-source
+integer cursors that ride the Orbax checkpoint as a ``stream`` extra item,
+a bounded background producer feeding the device-prefetch double buffer,
+and chaos verbs (``stall_source`` / ``corrupt_record``) on the shared
+``DTF_FAULT_INJECT`` grammar. Kill the run at any step and the resumed
+batch sequence is BYTE-identical to the uninterrupted one — including a
+dp8→dp4 shrink, because all stream state is host-count-invariant
+(global-batch addressing; per-host cursors are a row slice, not state).
+
+Like ``fault/``, ``tune/`` and ``telemetry/``, this package is **jax-free
+at module level** (srclint-fenced): batch assembly is pure host numpy and
+must import — and be testable — with no backend present; device placement
+belongs to the Trainer.
+"""
+
+from dtf_tpu.data.stream.mixture import MIX_SALT, STATE_VERSION, MixtureStream
+from dtf_tpu.data.stream.persist import EXTRA_ITEM, StreamCheckpointHook
+from dtf_tpu.data.stream.sources import TFRecordSource, TokenBinSource
+from dtf_tpu.data.stream.spec import (MANIFEST_KEY, build_stream,
+                                      parse_stream_spec,
+                                      resolve_stream_spec)
+
+__all__ = [
+    "MIX_SALT", "STATE_VERSION", "MixtureStream", "EXTRA_ITEM",
+    "StreamCheckpointHook", "TFRecordSource", "TokenBinSource",
+    "MANIFEST_KEY", "build_stream", "parse_stream_spec",
+    "resolve_stream_spec",
+]

@@ -1,0 +1,473 @@
+"""The multi-source weighted-mixture stream with checkpointed resume.
+
+Design invariants (docs/DATA.md):
+
+- **Counter-based, no hidden state.** Which source supplies each example of
+  global batch ``s`` is drawn by rng keyed ``[seed, salt, s]``; which bytes
+  a source returns for its ``i``-th example is keyed by ``i`` alone. The
+  ENTIRE realized batch sequence is therefore a pure function of
+  ``(spec, seed, weight schedule)`` plus one integer cursor per source —
+  that tuple IS the checkpointable :meth:`MixtureStream.state`.
+- **Global addressing, host slicing.** Draws and cursors describe the
+  GLOBAL batch; a host materializes only its row range
+  (:meth:`dtf_tpu.core.mesh.HostView.batch_rows`). Cursor state is thus
+  host-count-invariant, which makes the dp8→dp4 shrink resume a pure
+  re-partition: the survivors build the same global sequence and slice
+  different rows of it.
+- **Realized fractions converge** to the requested weights (multinomial
+  draws per row), and :meth:`reweight` changes the target at a NAMED step,
+  recorded in the weight schedule so a resumed run replays the same mix.
+- **Backpressure is visible, never fatal.** The optional bounded producer
+  thread (``producer_depth``) assembles batches ahead of the consumer;
+  when the trainer outruns it the wait lands in the existing ``data_wait``
+  span, and :meth:`stats` reports per-source throughput, queue depth and
+  realized fractions for the RunReport.
+
+jax-free at module level (srclint-fenced like ``fault/``/``tune/``): batch
+assembly is pure host numpy; device placement stays the Trainer's job.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("dtf_tpu")
+
+#: SeedSequence salt for the per-step source draw (disjoint from the
+#: sources' own example streams and the readers' batch streams).
+MIX_SALT = 0x5EED_00F2
+
+#: StreamState schema version (bump on incompatible layout changes).
+STATE_VERSION = 1
+
+#: snapshots kept for ``state_at`` (must exceed the deepest lookahead:
+#: producer queue + trainer prefetch; recompute covers anything older).
+_KEEP_SNAPSHOTS = 128
+
+
+class MixtureStream:
+    """Weighted mixture over resumable sources (see module docstring).
+
+    ``sources`` — objects with ``.name`` and ``.example(i) -> row dict``
+    (``dtf_tpu/data/stream/sources.py``); all must share a row schema.
+    ``weights`` — ``{name: weight}`` (normalized here; all > 0).
+    ``global_batch`` — rows per GLOBAL batch; this instance materializes
+    the ``host_view`` slice of it (default: the whole batch).
+    ``producer_depth`` — 0: assemble inline in the consumer's ``next()``;
+    N>0: a bounded background thread keeps up to N batches staged.
+    """
+
+    def __init__(self, sources: Sequence, weights: Dict[str, float],
+                 global_batch: int, *, seed: int = 0, host_view=None,
+                 producer_depth: int = 0, stall_s: float = 1.0):
+        if not sources:
+            raise ValueError("need at least one source")
+        names = [s.name for s in sources]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate source names: {names}")
+        if set(weights) != set(names):
+            raise ValueError(
+                f"weights {sorted(weights)} must name exactly the sources "
+                f"{sorted(names)}")
+        if global_batch < 1:
+            raise ValueError(f"global_batch must be >= 1, got {global_batch}")
+        self.sources = list(sources)
+        self.names = names
+        self.global_batch = int(global_batch)
+        self.seed = int(seed)
+        #: ``host_view=None`` means "the whole global batch" (single-host
+        #: runs) WITHOUT touching dtf_tpu.core.mesh — HostView lives in a
+        #: jax-importing module, and this package must work with no
+        #: backend at all (the srclint fence's dynamic twin).
+        self.host_view = host_view
+        self._host_rows = (host_view.batch_rows(global_batch)
+                           if host_view is not None
+                           else (0, self.global_batch))
+        self.producer_depth = int(producer_depth)
+        self.stall_s = float(stall_s)
+        #: weight schedule: [[step, {name: weight}], ...] sorted by step;
+        #: entry k applies from its step until the next entry's.
+        self._schedule: List[list] = [[0, self._normalize(weights)]]
+        self._cursors = {n: 0 for n in names}
+        self._next_step = 0
+        self._snapshots: Dict[int, dict] = {0: dict(self._cursors)}
+        self._lock = threading.Lock()
+        self._started = False
+        self._stop = threading.Event()
+        self._fault = None
+        self._fault_fired = False
+        self._stats = {
+            "batches": 0, "examples": {n: 0 for n in names},
+            "produce_s": 0.0, "producer_blocked_s": 0.0,
+            "consumer_wait_s": 0.0, "queue_depth_max": 0,
+            "stalls": 0,
+        }
+        self._validate_schema()
+
+    # ------------------------------------------------------------- schedule
+
+    @staticmethod
+    def _normalize(weights: Dict[str, float]) -> Dict[str, float]:
+        if any(w <= 0 for w in weights.values()):
+            raise ValueError(f"weights must be > 0, got {weights}")
+        total = float(sum(weights.values()))
+        return {n: float(w) / total for n, w in weights.items()}
+
+    def _weights_at(self, step: int) -> np.ndarray:
+        entry = self._schedule[0][1]
+        for start, w in self._schedule:
+            if start <= step:
+                entry = w
+            else:
+                break
+        return np.asarray([entry[n] for n in self.names], np.float64)
+
+    def reweight(self, at_step: int, weights: Dict[str, float]) -> None:
+        """Change the target mixture, effective at global step ``at_step``.
+
+        ``at_step`` must not precede batches already produced — the draws
+        for those steps are history a resume must replay, so rewriting
+        them would fork the sequence. The new entry is recorded in the
+        weight schedule and rides :meth:`state` into the checkpoint.
+        """
+        with self._lock:
+            if at_step < self._next_step:
+                raise ValueError(
+                    f"reweight at step {at_step} would rewrite history "
+                    f"(next step is {self._next_step})")
+            if set(weights) != set(self.names):
+                raise ValueError(
+                    f"reweight {sorted(weights)} must name exactly the "
+                    f"sources {sorted(self.names)}")
+            norm = self._normalize(weights)
+            # build + sort LOCALLY, publish once: _weights_at reads the
+            # schedule without this lock (the producer thread's _draw), so
+            # it must never observe a half-sorted list
+            schedule = ([e for e in self._schedule if e[0] != at_step]
+                        + [[at_step, norm]])
+            schedule.sort(key=lambda e: e[0])
+            self._schedule = schedule
+            log.info("mixture reweighted at step %d: %s", at_step,
+                     {n: round(w, 4) for n, w in norm.items()})
+
+    # ------------------------------------------------------------ the draws
+
+    def _draw(self, step: int) -> np.ndarray:
+        """Source id per GLOBAL row of batch ``step`` (pure)."""
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, MIX_SALT, int(step)]))
+        return rng.choice(len(self.sources), size=self.global_batch,
+                          p=self._weights_at(step))
+
+    def _counts(self, ids: np.ndarray) -> np.ndarray:
+        return np.bincount(ids, minlength=len(self.sources))
+
+    def _build(self, step: int, cursors: Dict[str, int],
+               ids: Optional[np.ndarray] = None) -> dict:
+        """This host's slice of global batch ``step`` at ``cursors``
+        (pure in the cursors; does not advance them)."""
+        if ids is None:
+            ids = self._draw(step)
+        # global example index per row: cursor + rank within its source
+        idx = np.empty(self.global_batch, np.int64)
+        for k, name in enumerate(self.names):
+            m = ids == k
+            idx[m] = cursors[name] + np.arange(int(m.sum()))
+        start, stop = self._host_rows
+        rows = [self.sources[int(ids[r])].example(int(idx[r]))
+                for r in range(start, stop)]
+        return {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+
+    def _validate_schema(self) -> None:
+        ref = self.sources[0].example(0)
+        for s in self.sources[1:]:
+            row = s.example(0)
+            if set(row) != set(ref):
+                raise ValueError(
+                    f"source {s.name!r} schema {sorted(row)} != "
+                    f"{self.sources[0].name!r} schema {sorted(ref)}")
+            for k in ref:
+                if (row[k].shape != ref[k].shape
+                        or row[k].dtype != ref[k].dtype):
+                    raise ValueError(
+                        f"source {s.name!r} field {k!r} "
+                        f"{row[k].shape}/{row[k].dtype} != "
+                        f"{ref[k].shape}/{ref[k].dtype}")
+
+    def template_batch(self) -> dict:
+        """The NEXT batch this host would produce, without advancing any
+        cursor — for shape/sharding probes (``batch_shardings_for``)."""
+        with self._lock:
+            return self._build(self._next_step, dict(self._cursors))
+
+    def produce(self, step: int) -> dict:
+        """Build batch ``step`` and advance the cursors past it. Steps
+        must be consumed in order (the cursor IS the order)."""
+        with self._lock:
+            if step != self._next_step:
+                raise ValueError(
+                    f"produce({step}) out of order; next step is "
+                    f"{self._next_step}")
+            cursors = dict(self._cursors)
+        fault = self._fault
+        if (fault is not None and not self._fault_fired
+                and step >= fault.step):
+            self._fault_fired = True
+            src = self.sources[fault.source or 0]
+            if fault.kind == "stall_source":
+                self._stats["stalls"] += 1
+                log.warning(
+                    "stream fault: stalling source %r for %.1fs at step "
+                    "%d (latency-only — batches are unchanged)",
+                    src.name, self.stall_s, step)
+                time.sleep(self.stall_s)
+            elif hasattr(src, "poison_next"):
+                src.poison_next()
+            else:
+                log.warning(
+                    "stream fault corrupt_record targets source %r, which "
+                    "has no record layer; verb ignored", src.name)
+        t0 = time.perf_counter()
+        ids = self._draw(step)
+        batch = self._build(step, cursors, ids)
+        counts = self._counts(ids)
+        with self._lock:
+            for k, name in enumerate(self.names):
+                self._cursors[name] += int(counts[k])
+                self._stats["examples"][name] += int(counts[k])
+            self._next_step = step + 1
+            self._snapshots[step + 1] = dict(self._cursors)
+            for old in [s for s in self._snapshots
+                        if s < step + 1 - _KEEP_SNAPSHOTS]:
+                del self._snapshots[old]
+            self._stats["batches"] += 1
+            self._stats["produce_s"] += time.perf_counter() - t0
+        return batch
+
+    # ----------------------------------------------------- state & resume
+
+    def state(self) -> dict:
+        """The live StreamState (cursors as of the last PRODUCED batch —
+        checkpoints should use :meth:`state_at` with the saved step so a
+        prefetched-but-untrained batch is not baked into the resume
+        point)."""
+        with self._lock:
+            return self._state_dict(self._next_step, dict(self._cursors))
+
+    def _state_dict(self, next_step: int, cursors: dict) -> dict:
+        return {
+            "version": STATE_VERSION,
+            "next_step": int(next_step),
+            "cursors": {n: int(c) for n, c in cursors.items()},
+            "schedule": [[int(s), {n: float(w) for n, w in ws.items()}]
+                         for s, ws in self._schedule],
+            "seed": self.seed,
+            "global_batch": self.global_batch,
+        }
+
+    def cursors_at(self, step: int) -> Dict[str, int]:
+        """Per-source cursors after batches ``0..step-1`` — from the
+        snapshot ring when the producer has been there, recomputed from
+        the pure draws otherwise (O(step) rng work, restore-time only)."""
+        with self._lock:
+            snap = self._snapshots.get(step)
+            if snap is not None:
+                return dict(snap)
+        cursors = {n: 0 for n in self.names}
+        for s in range(step):
+            counts = self._counts(self._draw(s))
+            for k, name in enumerate(self.names):
+                cursors[name] += int(counts[k])
+        return cursors
+
+    def state_at(self, step: int) -> dict:
+        """StreamState as of checkpoint step ``step`` (batches
+        ``0..step-1`` consumed). This is the Checkpointer extra-item
+        provider: with a background producer running ahead of training,
+        the LIVE cursors include staged batches the restore must replay —
+        the saved state must describe the trained step, not the
+        producer's lookahead."""
+        return self._state_dict(step, self.cursors_at(step))
+
+    def restore(self, state: dict) -> None:
+        """Resume from a saved StreamState (before iteration starts).
+
+        Validates the identity facts (sources, seed, global batch) so a
+        stream built from a DIFFERENT spec cannot silently impersonate the
+        checkpointed one, then adopts cursors + weight schedule. Works
+        across host counts: the state is global (see module docstring).
+        """
+        if self._started:
+            raise RuntimeError("cannot restore a stream already iterating")
+        if int(state.get("version", -1)) != STATE_VERSION:
+            raise ValueError(
+                f"StreamState version {state.get('version')!r} != "
+                f"{STATE_VERSION}")
+        if sorted(state["cursors"]) != sorted(self.names):
+            raise ValueError(
+                f"StreamState sources {sorted(state['cursors'])} != this "
+                f"stream's {sorted(self.names)} — the mixture spec changed")
+        if int(state["seed"]) != self.seed:
+            raise ValueError(
+                f"StreamState seed {state['seed']} != {self.seed}")
+        if int(state["global_batch"]) != self.global_batch:
+            raise ValueError(
+                f"StreamState global_batch {state['global_batch']} != "
+                f"{self.global_batch} — resuming at a different batch "
+                "size forks the sequence")
+        schedule = [[int(s), self._normalize(dict(ws))]
+                    for s, ws in state["schedule"]]
+        schedule.sort(key=lambda e: e[0])
+        with self._lock:
+            self._cursors = {n: int(c) for n, c in state["cursors"].items()}
+            self._next_step = int(state["next_step"])
+            self._schedule = schedule
+            self._snapshots = {self._next_step: dict(self._cursors)}
+
+    def seek(self, step: int) -> None:
+        """Fast-forward to ``next_step == step`` by replaying the pure
+        draw counts — the LEGACY-checkpoint resume path (a checkpoint
+        without a stream item: the spec still determines everything
+        except live reweights, which a legacy checkpoint never had)."""
+        if self._started:
+            raise RuntimeError("cannot seek a stream already iterating")
+        cursors = self.cursors_at(step)
+        with self._lock:
+            self._cursors = cursors
+            self._next_step = int(step)
+            self._snapshots = {int(step): dict(cursors)}
+
+    # ---------------------------------------------------------- iteration
+
+    @property
+    def next_step(self) -> int:
+        with self._lock:
+            return self._next_step
+
+    def arm_fault(self, plan, *, stall_s: Optional[float] = None) -> None:
+        """Install a :class:`dtf_tpu.fault.inject.StreamFaultPlan`."""
+        if plan is not None:
+            log.info("stream fault armed: %s", plan)
+        self._fault = plan
+        self._fault_fired = False
+        if stall_s is not None:
+            self.stall_s = float(stall_s)
+
+    def close(self) -> None:
+        self._stop.set()
+
+    def __iter__(self) -> Iterator[dict]:
+        if self.producer_depth > 0:
+            return self._background_iter()
+        return self._inline_iter()
+
+    def _inline_iter(self) -> Iterator[dict]:
+        self._started = True
+        while not self._stop.is_set():
+            yield self.produce(self.next_step)
+
+    def _background_iter(self) -> Iterator[dict]:
+        """Bounded producer thread: up to ``producer_depth`` batches
+        staged; a full queue blocks the PRODUCER (bounded host memory), an
+        empty one blocks the CONSUMER (that wait is the trainer's
+        ``data_wait`` span — backpressure made visible, never fatal)."""
+        self._started = True
+        q: queue.Queue = queue.Queue(maxsize=self.producer_depth)
+        stop = self._stop
+
+        def run():
+            try:
+                while not stop.is_set():
+                    batch = self.produce(self.next_step)
+                    while not stop.is_set():
+                        try:
+                            t0 = time.perf_counter()
+                            q.put(batch, timeout=0.2)
+                            self._stats["producer_blocked_s"] += (
+                                time.perf_counter() - t0)
+                            break
+                        except queue.Full:
+                            self._stats["producer_blocked_s"] += 0.2
+            except BaseException as e:  # noqa: BLE001 — surfaced below:
+                # a producer death must raise in the CONSUMER, not vanish
+                # in a daemon thread
+                q.put(e)
+
+        thread = threading.Thread(target=run, daemon=True,
+                                  name="dtf-stream-producer")
+        thread.start()
+        try:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    item = q.get(timeout=0.2)
+                except queue.Empty:
+                    self._stats["consumer_wait_s"] += (
+                        time.perf_counter() - t0)
+                    if stop.is_set():
+                        return      # close() ends the stream like the
+                    continue        # inline iterator does, never hangs
+                self._stats["consumer_wait_s"] += time.perf_counter() - t0
+                self._stats["queue_depth_max"] = max(
+                    self._stats["queue_depth_max"], q.qsize() + 1)
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            while True:      # unblock a producer stuck on a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=5.0)
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Per-source throughput / realized-fraction / queue-depth facts
+        for the RunReport (host counters only — zero device work)."""
+        with self._lock:
+            total = sum(self._stats["examples"].values())
+            target = self._weights_at(max(self._next_step - 1, 0))
+            per_source = {
+                n: {
+                    "examples": self._stats["examples"][n],
+                    "realized_frac": round(
+                        self._stats["examples"][n] / total, 6)
+                    if total else 0.0,
+                    "target_frac": round(float(target[k]), 6),
+                    "cursor": self._cursors[n],
+                }
+                for k, n in enumerate(self.names)
+            }
+            produce_s = self._stats["produce_s"]
+            return {
+                "batches": self._stats["batches"],
+                "next_step": self._next_step,
+                "global_batch": self.global_batch,
+                "per_source": per_source,
+                "produce_s": round(produce_s, 3),
+                "batches_per_sec": round(
+                    self._stats["batches"] / produce_s, 2)
+                if produce_s else None,
+                "producer_depth": self.producer_depth,
+                "producer_blocked_s": round(
+                    self._stats["producer_blocked_s"], 3),
+                "consumer_wait_s": round(
+                    self._stats["consumer_wait_s"], 3),
+                "queue_depth_max": self._stats["queue_depth_max"],
+                "reweights": len(self._schedule) - 1,
+                # ACTUAL CRC-skip events from the sources' read paths
+                # (real bit rot and the injected verb alike) — counting
+                # at the injection site would miss real damage entirely
+                "corrupt_skips": sum(getattr(s, "corrupt_skips", 0)
+                                     for s in self.sources),
+                "stalls": self._stats["stalls"],
+            }

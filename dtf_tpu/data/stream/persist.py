@@ -1,0 +1,71 @@
+"""StreamState ⇄ checkpoint plumbing — the resume half of the contract.
+
+One hook wires a :class:`~dtf_tpu.data.stream.mixture.MixtureStream` into
+the existing checkpoint lifecycle with zero Trainer changes:
+
+- **save side**: construction registers ``stream.state_at`` as the
+  Checkpointer's ``"stream"`` extra-item provider, so EVERY save path —
+  periodic :class:`~dtf_tpu.hooks.CheckpointHook`, the PreemptionHook's
+  SIGTERM ``save_durable``, the end-of-run force save — stamps the
+  StreamState for exactly the step being saved (NOT the producer's
+  lookahead position; ``state_at`` exists for precisely that skew).
+- **restore side**: ``begin`` runs after the Trainer's restore-if-exists,
+  so :attr:`Checkpointer.last_restored_step` names the step actually
+  loaded (the guarded fallback walk included). The stream restores the
+  matching StreamState; a LEGACY checkpoint without one WARNs and
+  fast-forwards by replaying the pure draws (:meth:`MixtureStream.seek`)
+  — correct whenever the spec is unchanged (the manifest guard's job),
+  minus any live reweights the legacy checkpoint never recorded.
+
+Duck-typed against :class:`dtf_tpu.hooks.Hook` (the FaultHook idiom): no
+jax import, so the package fence holds.
+"""
+
+from __future__ import annotations
+
+import logging
+
+log = logging.getLogger("dtf_tpu")
+
+#: the Composite member name StreamState rides under.
+EXTRA_ITEM = "stream"
+
+
+class StreamCheckpointHook:
+    """Wire a MixtureStream's state into an existing Checkpointer (see
+    module docstring). Place anywhere in the hook list — the provider
+    fires inside ``Checkpointer.save`` itself, not at hook order."""
+
+    telemetry_bucket = "checkpoint"
+
+    def __init__(self, ckpt, stream):
+        self.ckpt = ckpt
+        self.stream = stream
+        if ckpt is not None:
+            ckpt.add_extra_provider(EXTRA_ITEM, stream.state_at)
+
+    def begin(self, state) -> None:
+        if self.ckpt is None:
+            return
+        step = self.ckpt.last_restored_step
+        if step is None:
+            return                      # fresh run: stream starts at 0
+        saved = self.ckpt.restore_extra(EXTRA_ITEM, step=step)
+        if saved is None:
+            log.warning(
+                "checkpoint step %d has no stream state (pre-stream "
+                "legacy run); fast-forwarding the mixture by replaying "
+                "its draws to step %d — live reweights from the old run, "
+                "if any, are lost", step, step)
+            self.stream.seek(step)
+            return
+        self.stream.restore(saved)
+        log.info("stream resumed at step %d (cursors %s)", step,
+                 saved["cursors"])
+
+    def before_step(self, step: int) -> None: ...
+
+    def after_step(self, step: int, state, metrics) -> None: ...
+
+    def end(self, state) -> None:
+        self.stream.close()

@@ -1,0 +1,180 @@
+"""Resumable example sources — the per-dataset half of the mixture stream.
+
+A *source* is random-access over an infinite example sequence: ``example(i)``
+returns example ``i`` as an unbatched row dict, deterministically, with NO
+hidden iteration state. The mixture stream's only per-source state is then a
+single integer cursor ("examples consumed so far"), which is what makes the
+whole tier checkpointable in a handful of ints and re-partitionable across a
+shrunk host set (docs/DATA.md): example ``i`` is the same bytes no matter
+which host materializes it or when.
+
+Two shipped sources (both jax-free, numpy-only):
+
+- :class:`TokenBinSource` — a flat token ``.bin`` corpus via the existing
+  :class:`dtf_tpu.data.formats.TokenBinData` reader's ``example`` cursor
+  hook (random seq_len+1 windows keyed ``[seed, salt, index]``).
+- :class:`TFRecordSource` — TFRecord shards with an explicit record-offset
+  cursor: example ``i`` maps through the per-epoch permutation to a record,
+  whose payload CRC is verified AT READ TIME — a corrupt record is skipped
+  with a WARN (the next readable record in epoch order stands in) instead of
+  poisoning the run, riding the crc32c machinery the framing already uses.
+
+All sources feeding one mixture must share a schema (same keys, shapes,
+dtypes per row) — validated by the stream at construction.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+import numpy as np
+
+log = logging.getLogger("dtf_tpu")
+
+Row = Dict[str, np.ndarray]
+
+
+class TokenBinSource:
+    """LM examples over a flat token ``.bin`` corpus (nanoGPT packing).
+
+    ``example(i)`` is :meth:`dtf_tpu.data.formats.TokenBinData.example` —
+    one ``[seq_len]`` window drawn by counter-based rng from the global
+    example index, host-free. ``salt`` separates the rng streams of two
+    sources over the SAME file (two mixture components sampling one corpus
+    at different weights must not emit identical windows in lockstep).
+    """
+
+    def __init__(self, path: str, seq_len: int, *, mode: str = "clm",
+                 vocab_size: int = 0, seed: int = 0, salt: int = 0,
+                 name: Optional[str] = None):
+        from dtf_tpu.data.formats import TokenBinData
+
+        # local_batch is irrelevant for example() access; host 0/1 keeps
+        # the reader's own batch API usable for debugging.
+        self._data = TokenBinData(path, 1, seq_len, mode=mode,
+                                  vocab_size=vocab_size,
+                                  seed=seed + salt, host_index=0,
+                                  host_count=1)
+        self.name = name or path
+        self.seq_len = seq_len
+
+    def example(self, index: int) -> Row:
+        return self._data.example(index)
+
+
+class TFRecordSource:
+    """LM examples over TFRecord shards, with a record-offset cursor.
+
+    Records hold a fixed-length int64 ``tokens_key`` feature of
+    ``seq_len + 1`` tokens (the packed-window dump format); rows come out
+    in the CLM schema ``{input_ids, labels}`` so they mix with
+    :class:`TokenBinSource` rows. Example ``i`` maps to record
+    ``epoch_order(n, seed, i // n)[i % n]`` — the same deterministic
+    per-epoch reshuffle every array loader uses (``data/sharded.py``), as
+    an explicit offset mapping instead of iterator state.
+
+    Framing is indexed ONCE without payload verification
+    (:func:`dtf_tpu.data.tfrecord.tfrecord_spans`); each read then verifies
+    its own payload CRC (:func:`~dtf_tpu.data.tfrecord.record_payload_verified`)
+    and a mismatch SKIPS to the next record in epoch order with one WARN
+    per damaged record — deterministic under resume (the same file bytes
+    skip the same records) and chaos-testable (``corrupt_record`` verb:
+    :meth:`poison_next`).
+    """
+
+    #: bounded forward scan before giving up: a shard where this many
+    #: consecutive records fail CRC is damaged wholesale, not bit-rotted.
+    MAX_SKIP_SCAN = 64
+
+    def __init__(self, pattern: str, seq_len: int, *, tokens_key="tokens",
+                 seed: int = 0, name: Optional[str] = None):
+        import glob as glob_mod
+
+        from dtf_tpu.data.tfrecord import tfrecord_spans
+
+        files = sorted(glob_mod.glob(pattern))
+        if not files:
+            raise FileNotFoundError(f"no TFRecord files match {pattern!r}")
+        self._maps, file_ids, offs, lens = [], [], [], []
+        for i, f in enumerate(files):
+            off, length = tfrecord_spans(f, verify_payload_crc=False)
+            self._maps.append(memoryview(np.memmap(f, np.uint8, "r"))
+                              if off.size else None)
+            file_ids.append(np.full(off.size, i, np.int32))
+            offs.append(off)
+            lens.append(length)
+        self._file_id = np.concatenate(file_ids)
+        self._off = np.concatenate(offs)
+        self._len = np.concatenate(lens)
+        self.n_records = int(self._off.size)
+        if not self.n_records:
+            raise ValueError(f"no records in TFRecord files {pattern!r}")
+        self.name = name or pattern
+        self.seq_len = seq_len
+        self.tokens_key = tokens_key
+        self.seed = seed
+        #: actual CRC-skip events (real bit rot AND the injected verb) —
+        #: aggregated into MixtureStream.stats()["corrupt_skips"].
+        self.corrupt_skips = 0
+        self._warned: set[int] = set()
+        self._epoch_perm: tuple = (-1, None)   # (epoch, cached permutation)
+        self._poison_next = False
+
+    def poison_next(self) -> None:
+        """Arm the ``corrupt_record`` chaos verb: the next record read is
+        treated as a CRC mismatch, driving the exact skip-with-WARN branch
+        a damaged file takes — without touching the (possibly shared,
+        possibly read-only) data files."""
+        self._poison_next = True
+
+    def _payload(self, rec: int):
+        from dtf_tpu.data.tfrecord import record_payload_verified
+
+        if self._poison_next:
+            self._poison_next = False
+            return None
+        view = self._maps[int(self._file_id[rec])]
+        return record_payload_verified(view, int(self._off[rec]),
+                                       int(self._len[rec]))
+
+    def _record_for(self, i: int) -> int:
+        """Example index → record, through the per-epoch permutation —
+        computed ONCE per epoch and cached (per-example recompute would
+        be O(n_records) work per row and the producer could never outrun
+        the step on a real shard set)."""
+        from dtf_tpu.data.sharded import epoch_order
+
+        epoch, pos = divmod(i, self.n_records)
+        if self._epoch_perm[0] != epoch:
+            self._epoch_perm = (epoch, epoch_order(self.n_records,
+                                                   self.seed, epoch))
+        return int(self._epoch_perm[1][pos])
+
+    def example(self, index: int) -> Row:
+        from dtf_tpu.data.tfrecord import parse_example
+
+        index = int(index)
+        for hop in range(self.MAX_SKIP_SCAN):
+            rec = self._record_for(index + hop)
+            payload = self._payload(rec)
+            if payload is not None:
+                tokens = np.asarray(
+                    parse_example(payload)[self.tokens_key], np.int32)
+                if tokens.size < self.seq_len + 1:
+                    raise ValueError(
+                        f"{self.name}: record {rec} holds {tokens.size} "
+                        f"tokens < seq_len+1={self.seq_len + 1}")
+                win = tokens[:self.seq_len + 1]
+                return {"input_ids": win[:-1], "labels": win[1:]}
+            self.corrupt_skips += 1
+            if rec not in self._warned:
+                self._warned.add(rec)
+                log.warning(
+                    "%s: record %d failed its payload CRC; skipping it "
+                    "(the next record in epoch order stands in) — damaged "
+                    "data must not poison the run", self.name, rec)
+        raise ValueError(
+            f"{self.name}: {self.MAX_SKIP_SCAN} consecutive records failed "
+            f"their payload CRCs from example {index} — the shard is "
+            "damaged wholesale, not bit-rotted; re-fetch it")

@@ -1,0 +1,158 @@
+"""Stream-spec resolution: ``--stream_spec`` JSON → a built MixtureStream.
+
+The spec is the mixture's IDENTITY — which corpora, at which weights, under
+which seed — so it rides the model-config manifest
+(:func:`dtf_tpu.checkpoint.save_model_config`) next to the checkpoint: a
+resumed run that passes a different spec FAILS instead of silently training
+the tail of the run on a different mixture, and a resumed run that passes
+none inherits the manifest's (the same authority rule the decode config
+uses; ``cli/flags.resolve_decode_config``).
+
+Spec shape (JSON object, inline on the flag or a path to a ``.json`` file)::
+
+    {"sources": [{"name": "web",  "path": "/data/web",  "weight": 7},
+                 {"name": "code", "kind": "tfrecord",
+                  "pattern": "/data/code/*.tfrecord", "weight": 3}],
+     "reweight": [[1000, {"web": 5, "code": 5}]]}
+
+``kind`` defaults to ``tokens`` (a ``.bin`` corpus / dir for
+:class:`~dtf_tpu.data.stream.sources.TokenBinSource`); ``tfrecord`` maps to
+:class:`~dtf_tpu.data.stream.sources.TFRecordSource` (packed-window records,
+``tokens_key`` optional). Weights are relative (normalized by the stream).
+``reweight`` entries are applied in order at their named steps.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("dtf_tpu")
+
+#: the manifest key the training launchers write and serving ignores.
+MANIFEST_KEY = "stream_spec"
+
+
+def parse_stream_spec(text: str) -> dict:
+    """Parse + validate a stream spec (inline JSON or a ``.json`` path)."""
+    text = text.strip()
+    if not text:
+        raise ValueError("empty stream spec")
+    if not text.startswith("{"):
+        try:
+            with open(text) as f:
+                text = f.read()
+        except OSError as e:
+            # ValueError so launchers' flag-error conversion catches a
+            # mistyped path like any other bad spec
+            raise ValueError(f"stream spec path {text!r}: {e}") from e
+    spec = json.loads(text)
+    if not isinstance(spec, dict) or not isinstance(
+            spec.get("sources"), list) or not spec["sources"]:
+        raise ValueError(
+            "stream spec must be an object with a non-empty 'sources' list")
+    names = []
+    for src in spec["sources"]:
+        if not isinstance(src, dict) or "name" not in src:
+            raise ValueError(f"each source needs a 'name': {src!r}")
+        kind = src.get("kind", "tokens")
+        if kind not in ("tokens", "tfrecord"):
+            raise ValueError(
+                f"source {src['name']!r}: unknown kind {kind!r} "
+                "(tokens | tfrecord)")
+        if kind == "tokens" and "path" not in src:
+            raise ValueError(f"source {src['name']!r} needs a 'path'")
+        if kind == "tfrecord" and "pattern" not in src:
+            raise ValueError(f"source {src['name']!r} needs a 'pattern'")
+        if float(src.get("weight", 1.0)) <= 0:
+            raise ValueError(
+                f"source {src['name']!r}: weight must be > 0")
+        names.append(src["name"])
+    if len(set(names)) != len(names):
+        raise ValueError(f"duplicate source names in spec: {names}")
+    for entry in spec.get("reweight", []):
+        if (not isinstance(entry, (list, tuple)) or len(entry) != 2
+                or not isinstance(entry[1], dict)):
+            raise ValueError(
+                f"reweight entries are [step, {{name: weight}}]: {entry!r}")
+    return spec
+
+
+def canonical(spec: Optional[dict]) -> Optional[str]:
+    """The comparison form: key-sorted JSON (a reordered but identical
+    spec is the SAME mixture)."""
+    return None if spec is None else json.dumps(spec, sort_keys=True)
+
+
+def resolve_stream_spec(flag_value: str,
+                        manifest: Optional[dict]) -> Optional[dict]:
+    """Merge ``--stream_spec`` with the checkpoint manifest's spec.
+
+    Manifest has a spec: it WINS — an explicitly passed spec that differs
+    raises (a resumed run cannot silently change its mixture), a matching
+    or absent flag follows it. No manifest spec: the flag's spec (or None:
+    the launcher keeps its non-stream data path). Raises ValueError —
+    launchers convert to their UsageError.
+    """
+    flag_spec = parse_stream_spec(flag_value) if flag_value else None
+    saved = (manifest or {}).get(MANIFEST_KEY)
+    if saved is None:
+        return flag_spec
+    if flag_spec is not None and canonical(flag_spec) != canonical(saved):
+        raise ValueError(
+            "--stream_spec contradicts the mixture this checkpoint was "
+            "training on (model_config.json stream_spec); drop the flag "
+            "to resume the recorded mixture — changing it mid-run forks "
+            "the data sequence")
+    if flag_spec is None:
+        log.info("resuming with the manifest's stream_spec (sources: %s)",
+                 [s["name"] for s in saved["sources"]])
+    return saved
+
+
+def build_stream(spec: dict, *, global_batch: int, seq_len: int,
+                 vocab_size: int = 0, seed: int = 0, host_index: int = 0,
+                 host_count: int = 1, mode: str = "clm",
+                 producer_depth: int = 2, fault_plan=None):
+    """Spec → a ready :class:`~dtf_tpu.data.stream.mixture.MixtureStream`
+    (sources built, weights/reweights applied, fault verb armed)."""
+    from dtf_tpu.data.stream.mixture import MixtureStream
+    from dtf_tpu.data.stream.sources import TFRecordSource, TokenBinSource
+
+    host_view = None
+    if host_count > 1:
+        # HostView lives in the jax-importing mesh module; single-host
+        # builds (every no-backend context) must not pull it in
+        from dtf_tpu.core.mesh import HostView
+
+        host_view = HostView(host_index, host_count)
+
+    sources, weights = [], {}
+    for salt, src in enumerate(spec["sources"]):
+        name = src["name"]
+        if src.get("kind", "tokens") == "tfrecord":
+            sources.append(TFRecordSource(
+                src["pattern"], seq_len,
+                tokens_key=src.get("tokens_key", "tokens"),
+                seed=seed + salt, name=name))
+        else:
+            path = src["path"]
+            if os.path.isdir(path) or path.endswith(".bin"):
+                sources.append(TokenBinSource(
+                    path, seq_len, mode=mode, vocab_size=vocab_size,
+                    seed=seed, salt=salt, name=name))
+            else:
+                raise ValueError(
+                    f"source {name!r}: {path!r} is neither a .bin file "
+                    "nor a directory holding train.bin")
+        weights[name] = float(src.get("weight", 1.0))
+    stream = MixtureStream(
+        sources, weights, global_batch, seed=seed, host_view=host_view,
+        producer_depth=producer_depth)
+    for step, ws in spec.get("reweight", []):
+        stream.reweight(int(step), {n: float(w) for n, w in ws.items()})
+    if fault_plan is not None:
+        stream.arm_fault(fault_plan)
+    return stream

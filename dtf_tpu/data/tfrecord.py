@@ -368,6 +368,26 @@ def _bind_tfrecord(lib) -> None:
     lib.dtfio_tfrecord_close.argtypes = [ctypes.c_void_p]
 
 
+def record_payload_verified(view, offset: int, length: int):
+    """One record's payload slice, CRC-verified — or None on a mismatch.
+
+    ``view`` is the file's byte buffer (the per-file ``np.memmap`` view the
+    datasets already hold); ``offset``/``length`` come from
+    :func:`tfrecord_spans`. This is the streaming tier's corrupt-record
+    cursor hook (``dtf_tpu/data/stream``): framing is indexed ONCE without
+    payload verification, then each read verifies its own payload CRC so a
+    record damaged after indexing (bit rot, a torn shard on a network
+    mount) is SKIPPED with a WARN by the caller instead of poisoning the
+    run — the checkpoint-restore fallback philosophy applied to data.
+    """
+    payload = bytes(view[offset:offset + length])
+    (stored,) = struct.unpack_from("<I", bytes(
+        view[offset + length:offset + length + 4]), 0)
+    if stored != masked_crc32c(payload):
+        return None
+    return payload
+
+
 def read_tfrecords(path: str) -> Iterator[memoryview]:
     """Yield each record's payload as a zero-copy view into the mmap."""
     off, length = tfrecord_spans(path)

@@ -25,13 +25,15 @@ from dtf_tpu.fault.controller import (ControllerConfig, ControllerPolicy,
                                       RunController, read_heartbeat)
 from dtf_tpu.fault.elastic import (resume_state, survivor_host_count,
                                    survivor_mesh_shape)
-from dtf_tpu.fault.inject import (FaultHook, FaultPlan,
+from dtf_tpu.fault.inject import (FaultHook, FaultPlan, StreamFaultPlan,
                                   corrupt_latest_checkpoint,
-                                  corrupt_publish_version, maybe_hook)
+                                  corrupt_publish_version, maybe_hook,
+                                  maybe_stream_fault)
 
 __all__ = [
     "ControllerConfig", "ControllerPolicy", "Decision", "HostObservation",
     "RunController", "read_heartbeat", "FaultHook", "FaultPlan",
-    "corrupt_latest_checkpoint", "corrupt_publish_version", "maybe_hook",
+    "StreamFaultPlan", "corrupt_latest_checkpoint",
+    "corrupt_publish_version", "maybe_hook", "maybe_stream_fault",
     "resume_state", "survivor_host_count", "survivor_mesh_shape",
 ]

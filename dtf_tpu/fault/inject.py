@@ -62,6 +62,18 @@ KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash",
 SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request",
                "poison_draft", "corrupt_publish", "wedge_in_swap")
 
+#: the STREAMING-DATA-TIER verbs (ISSUE 15) — same env var, same grammar,
+#: targeting the mixture stream's producer (dtf_tpu/data/stream) instead
+#: of the training loop or the serving pump; every installer family
+#: ignores the others' kinds. ``stall_source@S[:source=k]`` makes source
+#: k's draws at step S block for the stream's stall window (a slow/hung
+#: reader: the bounded producer queue drains, ``data_wait`` spikes, the
+#: run must CONTINUE and the realized batches must be byte-identical —
+#: stalls are latency-only). ``corrupt_record@S[:source=k]`` poisons the
+#: next record source k reads after step S so the CRC check fails — the
+#: stream must skip it with a WARN, exactly the on-disk bit-rot path.
+STREAM_KINDS = ("stall_source", "corrupt_record")
+
 
 class InjectedCrash(RuntimeError):
     """The ``crash@S`` payload — a host died, in exception form."""
@@ -106,8 +118,9 @@ class FaultPlan:
     @classmethod
     def from_env(cls, env: Optional[Mapping] = None) -> Optional["FaultPlan"]:
         spec = (env if env is not None else os.environ).get(ENV_VAR, "")
-        if not spec or spec.partition("@")[0].strip() in SERVE_KINDS:
-            return None        # a serve verb rides past the trainer hook
+        kind = spec.partition("@")[0].strip()
+        if not spec or kind in SERVE_KINDS or kind in STREAM_KINDS:
+            return None   # serve/stream verbs ride past the trainer hook
         return cls.parse(spec)
 
     def applies_to(self, host_index: int) -> bool:
@@ -158,6 +171,61 @@ class ServeFaultPlan:
         if not spec or spec.partition("@")[0].strip() not in SERVE_KINDS:
             return None        # trainer verbs ride past the serve installer
         return cls.parse(spec)
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamFaultPlan:
+    """One seeded STREAM fault: ``<kind>@<step>[:source=<k>]``.
+
+    ``step`` is the mixture stream's global step (the batch index the
+    producer is building); ``source=None`` targets source 0 — a stream
+    fault needs a concrete victim, and 0 is the deterministic default.
+    Armed by :meth:`dtf_tpu.data.stream.MixtureStream.arm_fault` (the
+    launchers install it via ``maybe_stream_fault``); the trainer hook and
+    the serve installer each ignore this family's kinds.
+    """
+
+    kind: str
+    step: int
+    source: Optional[int] = None
+
+    def __post_init__(self):
+        if self.kind not in STREAM_KINDS:
+            raise ValueError(
+                f"unknown stream fault kind {self.kind!r}; "
+                f"have {STREAM_KINDS}")
+        if self.step < 0:
+            raise ValueError(f"fault step must be >= 0, got {self.step}")
+
+    @classmethod
+    def parse(cls, spec: str) -> "StreamFaultPlan":
+        body, _, tail = spec.strip().partition(":")
+        kind, at, step = body.partition("@")
+        if not at:
+            raise ValueError(f"fault spec {spec!r} needs '<kind>@<step>'")
+        source = None
+        if tail:
+            key, _, val = tail.partition("=")
+            if key != "source":
+                raise ValueError(
+                    f"unknown stream fault option {key!r} in {spec!r}")
+            source = int(val)
+        return cls(kind=kind.strip(), step=int(step), source=source)
+
+    @classmethod
+    def from_env(cls, env: Optional[Mapping] = None
+                 ) -> Optional["StreamFaultPlan"]:
+        spec = (env if env is not None else os.environ).get(ENV_VAR, "")
+        if not spec or spec.partition("@")[0].strip() not in STREAM_KINDS:
+            return None   # trainer/serve verbs ride past the stream arm
+        return cls.parse(spec)
+
+
+def maybe_stream_fault(env: Optional[Mapping] = None
+                       ) -> Optional[StreamFaultPlan]:
+    """The stream builders' one-liner: a StreamFaultPlan when
+    ``DTF_FAULT_INJECT`` names a stream verb, else None."""
+    return StreamFaultPlan.from_env(env)
 
 
 class FaultHook:

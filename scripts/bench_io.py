@@ -156,12 +156,56 @@ def bench_tfrecord(d):
     return out
 
 
+STREAM_BATCH = 32
+STREAM_SEQ = 128 if not TINY else 32
+STREAM_STEPS = 40 if TINY else 400
+
+
+def bench_stream(d):
+    """Mixture-stream assembly throughput (ISSUE 15, docs/DATA.md): two
+    token corpora mixed 70/30, inline vs the bounded background producer
+    — the number that says whether the data tier can outrun the step."""
+    from dtf_tpu.data.stream import MixtureStream, TokenBinSource
+
+    r = np.random.RandomState(0)
+    for name in ("a", "b"):
+        r.randint(0, 50_000, 200_000).astype(np.uint16).tofile(
+            os.path.join(d, f"{name}.bin"))
+    out = {"batch": STREAM_BATCH, "seq_len": STREAM_SEQ,
+           "steps": STREAM_STEPS, "weights": {"a": 0.7, "b": 0.3}}
+
+    def sources():
+        return [TokenBinSource(os.path.join(d, f"{n}.bin"), STREAM_SEQ,
+                               vocab_size=50_000, seed=1, salt=i, name=n)
+                for i, n in enumerate(("a", "b"))]
+
+    for label, depth in (("inline", 0), ("producer_depth2", 2)):
+        stream = MixtureStream(sources(), {"a": 0.7, "b": 0.3},
+                               STREAM_BATCH, seed=1, producer_depth=depth)
+        it = iter(stream)
+        next(it)                                 # warm (thread spin-up)
+        t0 = time.perf_counter()
+        for _ in range(STREAM_STEPS):
+            b = next(it)
+            assert b["input_ids"].dtype == np.int32
+        dt = time.perf_counter() - t0
+        stream.close()
+        out[f"{label}_batches_per_sec"] = round(STREAM_STEPS / dt, 1)
+        out[f"{label}_tokens_per_sec"] = round(
+            STREAM_STEPS * STREAM_BATCH * STREAM_SEQ / dt, 1)
+    stats = stream.stats()
+    out["realized_frac_a"] = stats["per_source"]["a"]["realized_frac"]
+    return out
+
+
 def main():
     row = {"tiny": TINY, "host_cpus": os.cpu_count()}
     with tempfile.TemporaryDirectory() as d:
         row["idx_epoch"] = bench_idx(d)
     with tempfile.TemporaryDirectory() as d:
         row["tfrecord_index"] = bench_tfrecord(d)
+    with tempfile.TemporaryDirectory() as d:
+        row["mixture_stream"] = bench_stream(d)
     if not TINY:
         with open(ARTIFACT, "w") as f:
             json.dump(row, f, indent=1)

@@ -129,7 +129,8 @@ def main(argv):
               StopAtStepHook(FLAGS.train_steps),
               *profiler_hooks(FLAGS, telemetry=tel)]
     trainer = Trainer(step, mesh, hooks=hooks, checkpointer=ckpt,
-                      place_batch=place_batch, telemetry=tel)
+                      place_batch=place_batch, telemetry=tel,
+                      prefetch=FLAGS.prefetch_depth)
     state = trainer.fit(state, batches)
     emit_run_report(tel, info, extra={"workload": "mnist",
                                       "fake_hosts": info.fake_hosts})

@@ -179,7 +179,8 @@ def main(argv):
                                flops_per_step=model_flops)],
         checkpointer=ckpt,
         place_batch=place_batch,
-        telemetry=tel)
+        telemetry=tel,
+        prefetch=FLAGS.prefetch_depth)
     state = trainer.fit(state, iter(data))
     emit_run_report(tel, info, extra={
         "launcher": "train_bert", "size": FLAGS.size,

@@ -90,6 +90,17 @@ flags.DEFINE_string("publish_dir", "", "weight hot-swap publishing "
                     "downtime (docs/RESILIENCE.md §9)")
 flags.DEFINE_integer("publish_every", 100, "with --publish_dir: publish "
                      "a version every N steps (plus once at end of run)")
+flags.DEFINE_string("stream_spec", "", "streaming data tier (ISSUE 15, "
+                    "docs/DATA.md): a JSON mixture spec (inline or a "
+                    ".json path) of weighted token sources — "
+                    "'{\"sources\": [{\"name\": ..., \"path\": ..., "
+                    "\"weight\": ...}, ...]}'. The spec is recorded in "
+                    "the model-config manifest and its per-source "
+                    "cursors ride every checkpoint as a 'stream' item, "
+                    "so a killed run resumes the EXACT batch sequence "
+                    "and a resumed run cannot silently change its "
+                    "mixture. Empty: the plain --data_dir/synthetic "
+                    "path")
 FLAGS = flags.FLAGS
 
 
@@ -264,24 +275,55 @@ def main(argv):
 
     from dtf_tpu.data import formats
 
-    data = formats.detect_token_data(
-        FLAGS.data_dir, FLAGS.batch_size, FLAGS.seq_len, mode="clm",
-        vocab_size=cfg.vocab_size, seed=FLAGS.seed,
-        host_index=info.process_id, host_count=info.num_processes)
-    if data is None:
-        if FLAGS.data_dir:
-            absl_logging.warning("no token .bin in %s; using synthetic data",
-                                 FLAGS.data_dir)
-        data = SyntheticData("gpt", FLAGS.batch_size, seed=FLAGS.seed,
-                             seq_len=FLAGS.seq_len, vocab_size=cfg.vocab_size,
-                             host_index=info.process_id,
-                             host_count=info.num_processes)
+    # the stream spec's authority chain: a manifest written by the run
+    # this logdir is resuming WINS over the flag (a resumed run cannot
+    # silently change its mixture) — read it before we overwrite it below
+    from dtf_tpu.checkpoint import load_model_config
+    from dtf_tpu.data import stream as dstream
+
+    prev_manifest = load_model_config(os.path.join(FLAGS.logdir, "ckpt"))
+    stream = None
+    try:
+        stream_spec = dstream.resolve_stream_spec(FLAGS.stream_spec,
+                                                  prev_manifest)
+        if stream_spec is not None:
+            from dtf_tpu.fault.inject import maybe_stream_fault
+
+            stream = dstream.build_stream(
+                stream_spec, global_batch=FLAGS.batch_size,
+                seq_len=FLAGS.seq_len, vocab_size=cfg.vocab_size,
+                seed=FLAGS.seed, host_index=info.process_id,
+                host_count=info.num_processes,
+                producer_depth=FLAGS.prefetch_depth,
+                fault_plan=maybe_stream_fault())
+    except (ValueError, OSError) as e:
+        # spec-shape AND spec-content errors (missing/unreadable corpus,
+        # bad reweight, indivisible batch) get the flag-error treatment
+        raise app.UsageError(f"--stream_spec: {e}")
+    if stream is not None:
+        data = stream
+    else:
+        data = formats.detect_token_data(
+            FLAGS.data_dir, FLAGS.batch_size, FLAGS.seq_len, mode="clm",
+            vocab_size=cfg.vocab_size, seed=FLAGS.seed,
+            host_index=info.process_id, host_count=info.num_processes)
+        if data is None:
+            if FLAGS.data_dir:
+                absl_logging.warning(
+                    "no token .bin in %s; using synthetic data",
+                    FLAGS.data_dir)
+            data = SyntheticData("gpt", FLAGS.batch_size, seed=FLAGS.seed,
+                                 seq_len=FLAGS.seq_len,
+                                 vocab_size=cfg.vocab_size,
+                                 host_index=info.process_id,
+                                 host_count=info.num_processes)
     kwargs = {}
     spec = None
     if sp:
         spec = P("data", "seq")
-        kwargs["batch_shardings"] = batch_shardings_for(
-            data.batch(0), mesh, spec)
+        probe = (stream.template_batch() if stream is not None
+                 else data.batch(0))
+        kwargs["batch_shardings"] = batch_shardings_for(probe, mesh, spec)
     if grads_fn is not None:
         if FLAGS.grad_shard:
             absl_logging.warning(
@@ -351,6 +393,11 @@ def main(argv):
         "moe_every": FLAGS.moe_every, "vocab_size": cfg.vocab_size,
         "d_model": cfg.d_model, "layers": cfg.layers, "heads": cfg.heads,
         "d_ff": cfg.d_ff, "kv_cache_dtype": ""}
+    if stream_spec is not None:
+        # the mixture identity rides the manifest: the resolve above
+        # guarantees a relaunch into this logdir keeps (or is refused a
+        # change of) exactly this spec
+        manifest_cfg[dstream.MANIFEST_KEY] = stream_spec
     save_model_config(ckpt.directory, manifest_cfg)
     publisher = None
     # only the checkpoint-owning process publishes (the PreemptionHook
@@ -381,6 +428,8 @@ def main(argv):
                          tokens_per_step=tokens_per_step,
                          model_flops_per_step=model_flops,
                          telemetry=tel),
+             *([dstream.StreamCheckpointHook(ckpt, stream)]
+               if stream is not None else []),
              CheckpointHook(ckpt, FLAGS.checkpoint_every),
              *([PublishHook(publisher, FLAGS.publish_every)]
                if publisher is not None else []),
@@ -397,12 +446,18 @@ def main(argv):
         step, mesh, hooks=hooks,
         checkpointer=ckpt,
         place_batch=place_batch,
-        telemetry=tel)
+        telemetry=tel,
+        prefetch=FLAGS.prefetch_depth)
     state = trainer.fit(state, iter(data))
-    emit_run_report(tel, info, extra={
+    extra = {
         "launcher": "train_gpt", "size": FLAGS.size,
         "batch_size": FLAGS.batch_size, "seq_len": FLAGS.seq_len,
-        "mesh": dict(mesh.shape)})
+        "mesh": dict(mesh.shape)}
+    if stream is not None:
+        # per-source throughput / realized fractions / queue depth in the
+        # RunReport (backpressure itself is the data_wait phase span)
+        extra["stream"] = stream.stats()
+    emit_run_report(tel, info, extra=extra)
     writer.close()
     ckpt.close()
     print(f"done: step={int(state.step)}")

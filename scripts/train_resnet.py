@@ -147,7 +147,8 @@ def main(argv):
                *profiler_hooks(FLAGS, telemetry=tel,
                                flops_per_step=model_flops)],
         checkpointer=ckpt,
-        telemetry=tel)
+        telemetry=tel,
+        prefetch=FLAGS.prefetch_depth)
     state = trainer.fit(state, iter(data))
     emit_run_report(tel, info, extra={
         "launcher": "train_resnet", "config": FLAGS.config,

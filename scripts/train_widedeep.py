@@ -108,7 +108,8 @@ def main(argv):
                StopAtStepHook(FLAGS.train_steps),
                *profiler_hooks(FLAGS, telemetry=tel)],
         checkpointer=ckpt,
-        telemetry=tel)
+        telemetry=tel,
+        prefetch=FLAGS.prefetch_depth)
     state = trainer.fit(state, iter(data))
     emit_run_report(tel, info, extra={
         "launcher": "train_widedeep", "batch_size": FLAGS.batch_size,

@@ -681,6 +681,77 @@ def test_srclint_fences_backend_imports_in_fault(tmp_path):
     assert not probs, probs
 
 
+def test_srclint_fences_backend_imports_in_stream(tmp_path):
+    """ISSUE 15 satellite: dtf_tpu/data/stream/ is fenced like fault/ and
+    tune/ — the mixture stream is pure host IO whose producer thread and
+    bench row must run with no backend present. Lazy in-function imports
+    pass; the shipping stream package must be clean."""
+    from dtf_tpu.analysis import srclint
+
+    sdir = tmp_path / "dtf_tpu" / "data" / "stream"
+    sdir.mkdir(parents=True)
+    bad = sdir / "bad.py"
+    bad.write_text("import jax\n\ndef f():\n    return jax.devices()\n")
+    probs = srclint.lint_file(str(bad))
+    assert sum("without a backend" in p for p in probs) == 1, probs
+    assert "dtf_tpu/stream/" in probs[0]
+
+    ok = sdir / "ok.py"
+    ok.write_text("def f():\n    import jax\n\n    return jax.devices()\n")
+    assert not srclint.lint_file(str(ok))
+
+    stream_dir = os.path.join(ROOT, "dtf_tpu", "data", "stream")
+    probs = []
+    for f in sorted(os.listdir(stream_dir)):
+        if f.endswith(".py"):
+            probs += [p for p in srclint.lint_file(
+                os.path.join(stream_dir, f)) if "without a backend" in p]
+    assert not probs, probs
+
+
+def test_stream_package_imports_without_backend(tmp_path,
+                                                cpu_sim_subprocess_env):
+    """Dynamic twin of the stream fence: build a mixture over two token
+    corpora, run it through the background producer, and checkpoint-shape
+    its state — in a child whose jax/jaxlib/tensorflow imports are
+    POISONED. The data tier must be drivable (and benchable) on a machine
+    with no backend at all."""
+    import subprocess
+    import sys as _sys
+
+    poison = tmp_path / "poison"
+    for mod in ("jax", "tensorflow", "jaxlib"):
+        d = poison / mod
+        d.mkdir(parents=True)
+        (d / "__init__.py").write_text(
+            "raise ImportError('no backend on this machine')\n")
+    env = dict(cpu_sim_subprocess_env)
+    env["PYTHONPATH"] = f"{poison}{os.pathsep}{ROOT}"
+    code = (
+        "import numpy as np, os\n"
+        "r = np.random.default_rng(0)\n"
+        "for n in ('a', 'b'):\n"
+        "    r.integers(0, 97, 4000).astype(np.uint16).tofile(n + '.bin')\n"
+        "from dtf_tpu.data.stream import MixtureStream, TokenBinSource\n"
+        "srcs = [TokenBinSource(n + '.bin', 16, vocab_size=97, salt=i,\n"
+        "                       name=n) for i, n in enumerate('ab')]\n"
+        "st = MixtureStream(srcs, {'a': 0.7, 'b': 0.3}, 8, seed=1,\n"
+        "                   producer_depth=2)\n"
+        "it = iter(st)\n"
+        "bs = [next(it) for _ in range(4)]\n"
+        "st.close()\n"
+        "assert bs[0]['input_ids'].shape == (8, 16)\n"
+        "assert st.state_at(2)['next_step'] == 2\n"
+        "from dtf_tpu.fault.inject import StreamFaultPlan\n"
+        "assert StreamFaultPlan.parse('stall_source@3').kind == "
+        "'stall_source'\n"
+        "print('NO_BACKEND_OK')\n")
+    proc = subprocess.run([_sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=120,
+                          cwd=str(tmp_path))
+    assert "NO_BACKEND_OK" in proc.stdout, (proc.stdout, proc.stderr)
+
+
 def test_fault_package_imports_without_backend(tmp_path,
                                                cpu_sim_subprocess_env):
     """Dynamic twin: the controller imports and classifies in a child

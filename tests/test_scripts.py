@@ -489,3 +489,7 @@ def test_bench_io_tiny_mode():
     assert tf["python_index_mb_per_sec"] > 0
     assert tf["native_index_mb_per_sec"] > 0
     assert tf["native_verifies_payload_crc"] is True
+    ms = row["mixture_stream"]          # ISSUE 15: the stream tier's row
+    assert ms["inline_batches_per_sec"] > 0
+    assert ms["producer_depth2_batches_per_sec"] > 0
+    assert abs(ms["realized_frac_a"] - 0.7) < 0.1
