@@ -11,7 +11,23 @@ root, importable with no dependencies at all.
 
 from __future__ import annotations
 
+import importlib.util
 import json
+import os
+
+
+def _hostio():
+    """Load ``dtf_tpu/_hostio.py`` by file location — executing ONLY that
+    stdlib-only module, never ``dtf_tpu/__init__`` (which pulls jax and
+    can hang against a dead axon tunnel). One atomic-replace
+    implementation for the whole repo, without breaking the parents'
+    never-import-dtf_tpu contract."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "dtf_tpu", "_hostio.py")
+    spec = importlib.util.spec_from_file_location("_dtf_hostio", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def load_runs(path: str) -> list:
@@ -33,8 +49,9 @@ def merge_runs(path: str, entry: dict, meta: dict,
     artifact — telemetry.run.merge_artifact's semantics, jax-free."""
     data = {"runs": load_runs(path)}
     data["runs"] = (data["runs"] + [{**entry, **meta}])[-keep_runs:]
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+    # atomic replace via the repo's one choke point: the sentinel's
+    # pathspec commits and concurrent report readers race these merges
+    _hostio().atomic_replace(path, json.dumps(data, indent=1))
     return data
 
 

@@ -17,6 +17,10 @@ Three passes, one CLI (``python -m dtf_tpu.analysis``):
   (counts + bytes) against the committed ``STATIC_ANALYSIS.json`` golden.
 - :mod:`dtf_tpu.analysis.jaxpr` — trace-level lints: float64 leaks, host
   callbacks inside the step, axis collectives outside ``shard_map``.
+- :mod:`dtf_tpu.analysis.host` — host-plane soundness over the jax-free
+  control plane (serve/fault/telemetry/data-stream/publish): lock
+  discipline, signal-handler deadlock, atomic-write choke point, clock
+  discipline (pure AST on :mod:`dtf_tpu.analysis.hostmodel`, no imports).
 
 The config registry (:mod:`dtf_tpu.analysis.configs`) covers the five
 BASELINE workloads plus the GPT flagship and the ``gpt_pipe*`` variants.

@@ -141,7 +141,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--configs", default="",
                         help="comma-separated registry names (default all)")
     parser.add_argument("--passes",
-                        default="specs,jaxpr,collective,hlo,memory",
+                        default="host,specs,jaxpr,collective,hlo,memory",
                         help="comma-separated passes to run")
     parser.add_argument("--write-golden", action="store_true",
                         help="regenerate STATIC_ANALYSIS.json comms + "

@@ -20,7 +20,7 @@ class Finding:
 
     config: str      # registry config name ("" = config-independent)
     pass_name: str   # "specs" | "jaxpr" | "collective" | "hlo" |
-                     # "memory" | "lint"
+                     # "memory" | "host" | "lint"
     check: str       # kebab-case check id, e.g. "shadowed-rule"
     severity: str    # one of SEVERITIES
     detail: str      # human-readable, one line

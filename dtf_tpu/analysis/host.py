@@ -1,0 +1,337 @@
+"""Host-plane soundness pass — race/lock/signal/atomic-write/clock lints.
+
+The analyzer fences every device-side dimension (sharding rules,
+collective soundness, HBM, comms budgets); this pass fences the jax-free
+CONTROL PLANE the resilience/serving PRs built — serve health/router,
+fault controller, flight recorder, publish watcher, stream producer —
+which is thread-heavy, signal-handling, and determinism-critical. Every
+defect in it so far was caught by hand review (the FlightRecorder SIGTERM
+self-deadlock, publish tmp+rename atomicity); these lints make those
+review findings fail-closed.
+
+Fenced scope: ``dtf_tpu/serve/``, ``dtf_tpu/fault/``,
+``dtf_tpu/telemetry/``, ``dtf_tpu/data/stream/``, ``dtf_tpu/publish.py``.
+AST-only over :mod:`dtf_tpu.analysis.hostmodel`'s class/thread/lock model
+(no imports executed, no compiles — the pass is tier-1 cheap).
+
+Finding classes (all ``severity=error``; file:line provenance like the
+collective pass):
+
+- ``unguarded-shared-state`` — an attribute written WITHOUT the owning
+  lock held, in a class that runs a ``threading.Thread`` target, where
+  the attribute is touched from both the thread side (the target's
+  in-class call closure) and the non-thread side. Guard every access
+  with the class lock, or pin a deliberate lock-free publish-once site
+  with ``# lock-ok: <why>`` (atomic reference assignment under the GIL
+  is the one sanctioned lock-free pattern).
+- ``signal-handler-deadlock`` — a plain ``threading.Lock`` acquirable
+  from a registered signal handler's call graph (cross-class through
+  typed attributes: ``self.flight = FlightRecorder(...)`` then
+  ``self.flight.dump()``). A signal lands between bytecodes on the main
+  thread; if the main thread holds the lock, the handler self-deadlocks
+  and the process goes SIGTERM-immune (the PR 5 FlightRecorder class).
+  Must be an ``RLock``; no pin — this one is fail-closed.
+- ``non-atomic-publish`` — a raw write-mode ``open()`` or bare
+  ``os.rename``/``os.replace``/``shutil.move`` outside the one choke
+  point :mod:`dtf_tpu._hostio` (``atomic_replace``/``append_line`` — the
+  ``ring_perm`` idiom: one constructor, lint everything else). A reader
+  racing a raw write sees a torn file. Deliberate raw IO (fault
+  injection's damage verbs) pins with ``# io-ok: <why>``.
+- ``clock-escape`` — a direct ``time.*()``/``random.*``/``os.urandom``/
+  global-state ``np.random`` call in modules whose contracts are
+  injectable clocks and counter-based rng. A raw call breaks
+  injectable-clock tests and bitwise replay. The sanctioned spellings:
+  a ``time.X`` as a keyword-parameter DEFAULT (``clock=time.monotonic``
+  — the injection point itself), seeded ``np.random.default_rng(seed)``
+  / ``np.random.SeedSequence([...])``, and ``# clock-ok: <why>`` pins
+  for genuinely wall-clock sites.
+
+docs/ANALYSIS.md §"Host-plane pass" documents the registry and pins.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import List, Optional, Sequence
+
+from dtf_tpu.analysis import hostmodel
+from dtf_tpu.analysis.findings import Finding
+
+PASS = "host"
+
+#: the one sanctioned write choke point (module basename is exempt).
+CHOKE_POINT = "dtf_tpu._hostio"
+
+PIN_CLOCK = "# clock-ok:"
+PIN_LOCK = "# lock-ok:"
+PIN_IO = "# io-ok:"
+
+#: wall-clock/rng spellings fenced when CALLED directly.
+_TIME_FNS = {"time", "monotonic", "perf_counter", "sleep", "time_ns",
+             "monotonic_ns", "perf_counter_ns", "process_time",
+             "process_time_ns"}
+
+#: np.random constructors that are counter-/seed-based WHEN given args.
+_NP_SEEDED_CTORS = {"default_rng", "SeedSequence", "Generator", "PCG64",
+                    "Philox", "SFC64", "MT19937"}
+
+#: fenced package paths under the dtf_tpu package root, plus publish.py.
+_FENCED_DIRS = (("serve",), ("fault",), ("telemetry",), ("data", "stream"))
+_FENCED_FILES = ("publish.py",)
+
+
+def package_root() -> str:
+    """The ``dtf_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def fenced_files(root: Optional[str] = None) -> List[str]:
+    """Every file the host pass fences, in deterministic order."""
+    root = root or package_root()
+    files: List[str] = []
+    for parts in _FENCED_DIRS:
+        d = os.path.join(root, *parts)
+        for r, ds, fs in os.walk(d):
+            ds[:] = [x for x in ds if x != "__pycache__"]
+            for f in sorted(fs):
+                if f.endswith(".py"):
+                    files.append(os.path.join(r, f))
+    for name in _FENCED_FILES:
+        p = os.path.join(root, name)
+        if os.path.exists(p):
+            files.append(p)
+    return files
+
+
+def _rel(path: str) -> str:
+    """Display path: repo-relative when under the repo, else as given."""
+    repo = os.path.dirname(package_root())
+    rel = os.path.relpath(os.path.abspath(path), repo)
+    return path if rel.startswith("..") else rel
+
+
+def _finding(check: str, path: str, lineno: int, msg: str) -> Finding:
+    return Finding("", PASS, check, "error", f"{_rel(path)}:{lineno}: {msg}")
+
+
+# --------------------------------------------------------- lock discipline
+
+def _lint_shared_state(mod: hostmodel.ModuleModel) -> List[Finding]:
+    pins = mod.pin_lines(PIN_LOCK)
+    out: List[Finding] = []
+    for cls in mod.classes:
+        if not cls.thread_targets:
+            continue
+        thread_funcs = cls.reachable(cls.thread_targets)
+        attrs = sorted({a.attr for a in cls.accesses})
+        for attr in attrs:
+            if attr in cls.locks or attr in cls.threadsafe:
+                continue
+            acc = [a for a in cls.accesses
+                   if a.attr == attr
+                   and a.func.split(".")[0] != "__init__"
+                   and a.lineno not in pins]
+            t_side = [a for a in acc if a.func in thread_funcs]
+            m_side = [a for a in acc if a.func not in thread_funcs]
+            if not t_side or not m_side:
+                continue        # single-side ownership needs no lock
+            unguarded_writes = [a for a in acc if a.write and not a.guarded]
+            if not unguarded_writes:
+                continue
+            w = min(unguarded_writes, key=lambda a: a.lineno)
+            target = ", ".join(sorted(cls.thread_targets))
+            out.append(_finding(
+                "unguarded-shared-state", mod.path, w.lineno,
+                f"{cls.name}.{attr} is written without the owning lock "
+                f"(e.g. in {w.func}) but is shared between the "
+                f"{target!r} thread side and other methods — guard every "
+                f"access with the class lock, or pin a deliberate "
+                f"publish-once site with '{PIN_LOCK} <why>'"))
+    return out
+
+
+def _lint_signal_locks(mod: hostmodel.ModuleModel,
+                       by_name: dict) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in mod.classes:
+        for handler in sorted(cls.signal_handlers):
+            # walk the handler's call closure, following typed-attribute
+            # calls into other modeled classes (visited set bounds it)
+            todo = [(cls, handler)]
+            visited = set()
+            while todo:
+                owner, entry = todo.pop()
+                if (owner.name, entry) in visited:
+                    continue
+                visited.add((owner.name, entry))
+                for f in owner.reachable({entry}):
+                    for lock, lineno in owner.acquires.get(f, []):
+                        if owner.locks.get(lock) != "Lock":
+                            continue
+                        out.append(_finding(
+                            "signal-handler-deadlock", owner.path, lineno,
+                            f"signal handler {cls.name}.{handler} can "
+                            f"acquire plain Lock {owner.name}.{lock} — a "
+                            f"signal landing while this thread holds it "
+                            f"self-deadlocks the handler (the process "
+                            f"goes SIGTERM-immune); use "
+                            f"threading.RLock() (the FlightRecorder "
+                            f"postmortem class)"))
+                    for attr, meth in owner.cross_calls.get(f, ()):
+                        other = by_name.get(owner.attr_types.get(attr, ""))
+                        if other is not None:
+                            todo.append((other, meth))
+    return out
+
+
+# ------------------------------------------------------- atomic-write lint
+
+def _is_name(node: ast.AST, *names: str) -> bool:
+    return isinstance(node, ast.Name) and node.id in names
+
+
+def _open_mode(node: ast.Call) -> Optional[str]:
+    mode = node.args[1] if len(node.args) > 1 else next(
+        (kw.value for kw in node.keywords if kw.arg == "mode"), None)
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return "r" if mode is None else None    # dynamic mode: not fenced
+
+
+def _lint_atomic_writes(mod: hostmodel.ModuleModel) -> List[Finding]:
+    if os.path.basename(mod.path) == "_hostio.py":
+        return []
+    pins = mod.pin_lines(PIN_IO)
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or node.lineno in pins:
+            continue
+        fn = node.func
+        if _is_name(fn, "open"):
+            mode = _open_mode(node)
+            if mode is not None and any(c in mode for c in "wax+"):
+                out.append(_finding(
+                    "non-atomic-publish", mod.path, node.lineno,
+                    f"raw open(..., {mode!r}) in the host control plane "
+                    f"— a reader racing this write sees a torn file; "
+                    f"route it through {CHOKE_POINT}.atomic_replace "
+                    f"(whole files) / append_line (jsonl), or pin "
+                    f"deliberate raw IO with '{PIN_IO} <why>'"))
+        elif (isinstance(fn, ast.Attribute)
+              and ((fn.attr in ("rename", "replace")
+                    and _is_name(fn.value, "os"))
+                   or (fn.attr == "move"
+                       and _is_name(fn.value, "shutil")))):
+            base = "os" if _is_name(fn.value, "os") else "shutil"
+            out.append(_finding(
+                "non-atomic-publish", mod.path, node.lineno,
+                f"bare {base}.{fn.attr} in the host control plane — the "
+                f"tmp+replace commit sequence lives in ONE place "
+                f"({CHOKE_POINT}.atomic_replace); a second hand-rolled "
+                f"copy is where the next torn-manifest bug comes from "
+                f"(pin deliberate raw IO with '{PIN_IO} <why>')"))
+    return out
+
+
+# -------------------------------------------------------------- clock lint
+
+def _np_random_attr(fn: ast.Attribute) -> Optional[str]:
+    """``np.random.X`` / ``numpy.random.X`` -> ``X``."""
+    base = fn.value
+    if (isinstance(base, ast.Attribute) and base.attr == "random"
+            and _is_name(base.value, "np", "numpy")):
+        return fn.attr
+    return None
+
+
+def _clock_spelling(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        if _is_name(fn.value, "time") and fn.attr in _TIME_FNS:
+            return f"time.{fn.attr}()"
+        if _is_name(fn.value, "random"):
+            return f"random.{fn.attr}()"
+        if _is_name(fn.value, "os") and fn.attr == "urandom":
+            return "os.urandom()"
+        if (fn.attr in ("now", "utcnow", "today")
+                and (_is_name(fn.value, "datetime", "date")
+                     or (isinstance(fn.value, ast.Attribute)
+                         and fn.value.attr in ("datetime", "date")))):
+            return f"datetime.{fn.attr}()"
+        np_attr = _np_random_attr(fn)
+        if np_attr is not None:
+            if np_attr in _NP_SEEDED_CTORS:
+                if not node.args and not node.keywords:
+                    return f"unseeded np.random.{np_attr}()"
+                return None       # seeded constructor: counter-based, ok
+            return f"np.random.{np_attr}() (global-state rng)"
+    return None
+
+
+def _lint_clock(mod: hostmodel.ModuleModel) -> List[Finding]:
+    pins = mod.pin_lines(PIN_CLOCK)
+    out: List[Finding] = []
+    for node in ast.walk(mod.tree):
+        if (isinstance(node, ast.ImportFrom)
+                and node.module in ("time", "random")
+                and node.lineno not in pins):
+            out.append(_finding(
+                "clock-escape", mod.path, node.lineno,
+                f"'from {node.module} import ...' in a clock-disciplined "
+                f"module — bare names dodge the time.*/random.* fence; "
+                f"import the module and thread calls through an "
+                f"injectable parameter (the clock=time.monotonic "
+                f"default idiom)"))
+            continue
+        if not isinstance(node, ast.Call) or node.lineno in pins:
+            continue
+        spelling = _clock_spelling(node)
+        if spelling is not None:
+            out.append(_finding(
+                "clock-escape", mod.path, node.lineno,
+                f"raw {spelling} in a clock-disciplined module — a "
+                f"direct wall-clock/rng call breaks injectable-clock "
+                f"tests and bitwise replay; thread it through the named "
+                f"clock/rng parameter (clock=time.monotonic / seeded "
+                f"np.random.default_rng), or pin a genuinely wall-clock "
+                f"site with '{PIN_CLOCK} <why>'"))
+    return out
+
+
+# --------------------------------------------------------------- the pass
+
+def lint_modules(mods: Sequence[hostmodel.ModuleModel]) -> List[Finding]:
+    by_name = {}
+    for m in mods:
+        for c in m.classes:
+            by_name.setdefault(c.name, c)
+    out: List[Finding] = []
+    for m in mods:
+        out += _lint_shared_state(m)
+        out += _lint_signal_locks(m, by_name)
+        out += _lint_atomic_writes(m)
+        out += _lint_clock(m)
+    return out
+
+
+def lint_paths(paths: Sequence[str]) -> List[Finding]:
+    """Lint explicit files (the seeded-defect tests' entry point)."""
+    mods = []
+    findings: List[Finding] = []
+    for p in paths:
+        try:
+            mods.append(hostmodel.build_module(p))
+        except SyntaxError as e:
+            findings.append(_finding("syntax-error", p, e.lineno or 0,
+                                     f"unparseable: {e.msg}"))
+    return findings + lint_modules(mods)
+
+
+def lint_host(root: Optional[str] = None) -> List[Finding]:
+    """The whole fenced tree — what the runner and ``lint.sh --full`` run."""
+    return lint_paths(fenced_files(root))
+
+
+__all__ = ["PASS", "fenced_files", "lint_host", "lint_modules",
+           "lint_paths"]

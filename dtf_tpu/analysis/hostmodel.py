@@ -1,0 +1,399 @@
+"""Shared AST model of the host control plane — classes, threads, locks,
+signal handlers, attribute traffic.
+
+The host soundness pass (:mod:`dtf_tpu.analysis.host`) asks three
+questions about the jax-free packages: which attributes are touched from a
+``threading.Thread`` target vs. the rest of the class, which locks a
+registered signal handler can reach, and which file/clock calls bypass the
+sanctioned choke points. This module builds the one per-class model those
+lints share, AST-only (no imports executed — the srclint discipline), so
+each lint is a cheap walk over prebuilt facts.
+
+Model granularity and deliberate limits (documented, not accidental):
+
+- **Per-class.** Threads, locks and attribute traffic are modeled within
+  one class; a thread that calls into ANOTHER class's methods is covered
+  by that class's own discipline (e.g. the stall watchdog thread calls
+  ``FlightRecorder.write_heartbeat``, whose guarded sections are
+  FlightRecorder's own model). The only cross-class edge the model keeps
+  is attribute TYPE (``self.flight = FlightRecorder(...)`` or a
+  constructor-parameter annotation), because the signal-handler lint must
+  follow ``self.flight.dump()`` into the class that owns the lock.
+- **Lexical guards.** An access counts as guarded when it sits inside a
+  ``with self.<lock>:`` block of the same function — the codebase's one
+  locking idiom. ``.acquire()``/``.release()`` pairs are recorded as
+  acquires (the signal lint needs them) but do not bless a region.
+- **Nested defs are call-time scopes.** A ``def run()`` inside a method
+  is the thread-target idiom; its body is walked with the guard state
+  RESET (the definition site's ``with`` does not hold when the thread
+  later runs it).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+#: constructors that make an attribute a lock (tracked by kind — the
+#: signal lint's whole point is Lock vs RLock).
+_LOCK_CTORS = {"Lock": "Lock", "RLock": "RLock"}
+
+#: constructors whose objects are internally synchronized — attributes
+#: bound to these are exempt from the shared-state lint.
+_THREADSAFE_CTORS = {
+    "Event", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+}
+
+#: method calls that mutate their receiver in place — ``self.x.append(...)``
+#: is a WRITE to ``x`` for the shared-state lint.
+_MUTATORS = {
+    "append", "appendleft", "extend", "insert", "remove", "pop", "popleft",
+    "popitem", "clear", "sort", "reverse", "update", "add", "discard",
+    "setdefault", "put", "put_nowait",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Access:
+    """One ``self.<attr>`` touch inside a class function."""
+
+    attr: str
+    lineno: int
+    write: bool
+    guarded: bool    # lexically inside `with self.<lock>:` of this func
+    func: str        # "method" or "method.<locals>.nested"
+
+
+@dataclasses.dataclass
+class ClassModel:
+    """Everything the host lints need to know about one class."""
+
+    name: str
+    path: str
+    lineno: int
+    funcs: Set[str] = dataclasses.field(default_factory=set)
+    locks: Dict[str, str] = dataclasses.field(default_factory=dict)
+    threadsafe: Set[str] = dataclasses.field(default_factory=set)
+    accesses: List[Access] = dataclasses.field(default_factory=list)
+    #: func -> in-class callees (methods and own nested defs, resolved)
+    calls: Dict[str, Set[str]] = dataclasses.field(default_factory=dict)
+    #: func -> {(attr, method)} for ``self.<attr>.<method>()`` calls
+    cross_calls: Dict[str, Set[Tuple[str, str]]] = dataclasses.field(
+        default_factory=dict)
+    #: func -> [(lock_attr, lineno)] — `with self.lock:` or `.acquire()`
+    acquires: Dict[str, List[Tuple[str, int]]] = dataclasses.field(
+        default_factory=dict)
+    thread_targets: Set[str] = dataclasses.field(default_factory=set)
+    signal_handlers: Set[str] = dataclasses.field(default_factory=set)
+    #: attr -> class name, from ``self.x = ClassName(...)`` or an
+    #: annotated ctor parameter assigned through (``self.x = param``)
+    attr_types: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def reachable(self, entries: Set[str]) -> Set[str]:
+        """In-class call-graph closure of ``entries``."""
+        seen: Set[str] = set()
+        todo = [e for e in entries if e in self.funcs]
+        while todo:
+            f = todo.pop()
+            if f in seen:
+                continue
+            seen.add(f)
+            todo += [c for c in self.calls.get(f, ()) if c not in seen]
+        return seen
+
+
+@dataclasses.dataclass
+class ModuleModel:
+    path: str
+    tree: ast.AST
+    src: str
+    classes: List[ClassModel]
+
+    def pin_lines(self, marker: str) -> Set[int]:
+        """Line numbers pinned by ``marker`` (e.g. ``# clock-ok:``): the
+        marker's own line plus the one after it, so a standalone comment
+        line pins the statement below (long lines have nowhere inline)."""
+        out: Set[int] = set()
+        for i, line in enumerate(self.src.splitlines(), 1):
+            if marker in line:
+                out.update((i, i + 1))
+        return out
+
+
+def _call_name(node: ast.AST) -> Optional[str]:
+    """Terminal name of a call target: ``threading.RLock`` -> "RLock"."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.<attr>`` -> attr name, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _store_base_attr(target: ast.AST) -> Optional[str]:
+    """The self-attribute a store target ultimately mutates:
+    ``self.x = ...``, ``self.x[k] = ...``, ``self.x[k][j] += ...`` and
+    ``self.x.y = ...`` all write ``x`` (container/object mutation is
+    mutation of the shared attribute)."""
+    while True:
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        elif isinstance(target, ast.Attribute) and not (
+                isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            target = target.value
+        else:
+            break
+    return _self_attr(target)
+
+
+class _FuncWalker(ast.NodeVisitor):
+    """Walk ONE class function (and its nested defs) collecting facts."""
+
+    def __init__(self, model: ClassModel, func: str,
+                 nested_names: Set[str]):
+        self.model = model
+        self.func = func
+        self.top = func.split(".")[0]
+        self.nested_names = nested_names
+        self.guard_depth = 0
+        model.calls.setdefault(func, set())
+        model.cross_calls.setdefault(func, set())
+        model.acquires.setdefault(func, [])
+
+    # ------------------------------------------------------------- helpers
+
+    def _access(self, attr: str, lineno: int, write: bool) -> None:
+        self.model.accesses.append(Access(
+            attr=attr, lineno=lineno, write=write,
+            guarded=self.guard_depth > 0, func=self.func))
+
+    def _resolve_local(self, name: str) -> Optional[str]:
+        if name in self.nested_names:
+            return f"{self.top}.<locals>.{name}"
+        return None
+
+    # -------------------------------------------------------------- scopes
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        # a nested def runs at CALL time: new scope, guard state reset
+        sub = f"{self.top}.<locals>.{node.name}"
+        self.model.funcs.add(sub)
+        walker = _FuncWalker(self.model, sub, self.nested_names)
+        for stmt in node.body:
+            walker.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # lambda bodies also run at call time; walk unguarded, same func
+        saved = self.guard_depth
+        self.guard_depth = 0
+        self.visit(node.body)
+        self.guard_depth = saved
+
+    def visit_With(self, node: ast.With) -> None:
+        held = 0
+        for item in node.items:
+            expr = item.context_expr
+            attr = _self_attr(expr)
+            if attr is not None and attr in self.model.locks:
+                self.model.acquires[self.func].append((attr, expr.lineno))
+                held += 1
+            else:
+                self.visit(expr)
+        self.guard_depth += held
+        for stmt in node.body:
+            self.visit(stmt)
+        self.guard_depth -= held
+
+    visit_AsyncWith = visit_With
+
+    # ------------------------------------------------------------- stores
+
+    def _visit_store_targets(self, targets) -> None:
+        for tgt in targets:
+            if isinstance(tgt, (ast.Tuple, ast.List)):
+                self._visit_store_targets(tgt.elts)
+                continue
+            base = _store_base_attr(tgt)
+            if base is not None:
+                self._access(base, tgt.lineno, write=True)
+                # subscript indexes still read values (incl. self attrs)
+                while isinstance(tgt, ast.Subscript):
+                    self.visit(tgt.slice)
+                    tgt = tgt.value
+            else:
+                self.visit(tgt)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._visit_store_targets(node.targets)
+        self.visit(node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._visit_store_targets([node.target])
+        if node.value is not None:
+            self.visit(node.value)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._visit_store_targets([node.target])
+        self.visit(node.value)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        self._visit_store_targets(node.targets)
+
+    # -------------------------------------------------------------- loads
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None:
+            self._access(attr, node.lineno,
+                         write=isinstance(node.ctx, (ast.Store, ast.Del)))
+            return
+        self.generic_visit(node)
+
+    # -------------------------------------------------------------- calls
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn = node.func
+        # threading.Thread(target=...) — the thread-side entry point
+        if _call_name(fn) == "Thread":
+            for kw in node.keywords:
+                if kw.arg != "target":
+                    continue
+                if isinstance(kw.value, ast.Name):
+                    local = self._resolve_local(kw.value.id)
+                    if local:
+                        self.model.thread_targets.add(local)
+                    elif kw.value.id in self.model.funcs:
+                        self.model.thread_targets.add(kw.value.id)
+                target_attr = _self_attr(kw.value)
+                if target_attr is not None:
+                    self.model.thread_targets.add(target_attr)
+        # signal.signal(SIG, self.handler)
+        if (_call_name(fn) == "signal" and isinstance(fn, ast.Attribute)
+                and len(node.args) >= 2):
+            handler = _self_attr(node.args[1])
+            if handler is not None:
+                self.model.signal_handlers.add(handler)
+        # self.m(...) / nested(...) / self.attr.m(...)
+        if isinstance(fn, ast.Attribute):
+            recv = fn.value
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                # an in-class call edge, not a data-attribute access —
+                # visit args only, so `self.produce(...)` doesn't read
+                # a phantom "produce" attribute
+                self.model.calls[self.func].add(fn.attr)
+                for arg in node.args:
+                    self.visit(arg)
+                for kw in node.keywords:
+                    self.visit(kw.value)
+                return
+            else:
+                recv_attr = _self_attr(recv)
+                if recv_attr is not None:
+                    if recv_attr in self.model.locks:
+                        if fn.attr == "acquire":
+                            self.model.acquires[self.func].append(
+                                (recv_attr, node.lineno))
+                    elif fn.attr in _MUTATORS:
+                        self._access(recv_attr, node.lineno, write=True)
+                        self.model.cross_calls[self.func].add(
+                            (recv_attr, fn.attr))
+                    else:
+                        self._access(recv_attr, node.lineno, write=False)
+                        self.model.cross_calls[self.func].add(
+                            (recv_attr, fn.attr))
+                    for arg in node.args:
+                        self.visit(arg)
+                    for kw in node.keywords:
+                        self.visit(kw.value)
+                    return
+        elif isinstance(fn, ast.Name):
+            local = self._resolve_local(fn.id)
+            if local:
+                self.model.calls[self.func].add(local)
+        self.generic_visit(node)
+
+
+def _annotation_name(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.split(".")[-1].split("[")[0] or None
+    return None
+
+
+def _collect_attr_bindings(model: ClassModel, fn: ast.FunctionDef) -> None:
+    """Pass 1 facts from one method: lock/threadsafe/typed attributes."""
+    params: Dict[str, str] = {}
+    if fn.name == "__init__":
+        for arg in fn.args.args + fn.args.kwonlyargs:
+            ann = _annotation_name(arg.annotation)
+            if ann:
+                params[arg.arg] = ann
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is None:
+                continue
+            ctor = _call_name(node.value) if isinstance(node.value,
+                                                        ast.Call) else None
+            if ctor in _LOCK_CTORS:
+                model.locks[attr] = _LOCK_CTORS[ctor]
+            elif ctor in _THREADSAFE_CTORS:
+                model.threadsafe.add(attr)
+            elif ctor and ctor[:1].isupper():
+                model.attr_types[attr] = ctor
+            elif (isinstance(node.value, ast.Name)
+                  and node.value.id in params):
+                model.attr_types[attr] = params[node.value.id]
+
+
+def build_class(path: str, node: ast.ClassDef) -> ClassModel:
+    model = ClassModel(name=node.name, path=path, lineno=node.lineno)
+    methods = [n for n in node.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    model.funcs = {m.name for m in methods}
+    for m in methods:
+        _collect_attr_bindings(model, m)
+    for m in methods:
+        nested = {n.name for n in ast.walk(m)
+                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                  and n is not m}
+        walker = _FuncWalker(model, m.name, nested)
+        for stmt in m.body:
+            walker.visit(stmt)
+    return model
+
+
+def build_module(path: str, src: Optional[str] = None) -> ModuleModel:
+    """Parse one file into its per-class models (never raises on bad
+    source — the caller reports a syntax problem as its own finding)."""
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    tree = ast.parse(src, filename=path)
+    classes = [build_class(path, n) for n in tree.body
+               if isinstance(n, ast.ClassDef)]
+    return ModuleModel(path=path, tree=tree, src=src, classes=classes)
+
+
+__all__ = ["Access", "ClassModel", "ModuleModel", "build_class",
+           "build_module"]

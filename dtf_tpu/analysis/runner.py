@@ -8,6 +8,7 @@ from typing import Sequence
 from dtf_tpu.analysis import collective as collective_pass
 from dtf_tpu.analysis import configs as cfgs
 from dtf_tpu.analysis import hlo as hlo_pass
+from dtf_tpu.analysis import host as host_pass
 from dtf_tpu.analysis import jaxpr as jaxpr_pass
 from dtf_tpu.analysis import memory as memory_pass
 from dtf_tpu.analysis import specs as specs_pass
@@ -15,9 +16,10 @@ from dtf_tpu.analysis.findings import Finding
 
 GOLDEN_BASENAME = "STATIC_ANALYSIS.json"
 
-#: every pass the runner knows, in execution order.  "hlo" and "memory"
-#: share one AOT compile per config (compile_program).
-ALL_PASSES = ("specs", "jaxpr", "collective", "hlo", "memory")
+#: every pass the runner knows, in execution order.  "host" is
+#: config-independent (AST lint over the jax-free control plane); "hlo"
+#: and "memory" share one AOT compile per config (compile_program).
+ALL_PASSES = ("host", "specs", "jaxpr", "collective", "hlo", "memory")
 
 
 def golden_path() -> str:
@@ -124,6 +126,11 @@ def analyze(names: Sequence[str] | None = None,
         golden = (hlo_pass.load_golden(path) if os.path.exists(path)
                   else {"budgets": {}})
     findings: list[Finding] = []
+    if "host" in passes:
+        # config-independent: race/lock/signal/atomic-write/clock lints
+        # over the jax-free control plane (serve/fault/telemetry/stream/
+        # publish) — pure AST, no trace or compile.
+        findings += host_pass.lint_host()
     if "collective" in passes:
         # config-independent: the mirrored-ring fence over every
         # registered custom_vjp ring pair (ops/collective_matmul).

@@ -83,7 +83,8 @@ class Checkpointer:
     """Thin Orbax CheckpointManager wrapper for TrainState pytrees."""
 
     def __init__(self, directory: str | os.PathLike, *, max_to_keep: int = 3,
-                 save_interval_steps: int = 1, async_save: bool = True):
+                 save_interval_steps: int = 1, async_save: bool = True,
+                 wall=time.time):
         directory = os.fspath(directory)
         os.makedirs(directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
@@ -102,6 +103,14 @@ class Checkpointer:
         #: (the streaming tier registers ``stream`` here so the SIGTERM
         #: ``save_durable`` path cannot forget the stream state).
         self._extra_providers: dict = {}
+        #: injectable wall clock stamping :attr:`resume_events` (tests
+        #: pin it; the host pass's clock-escape discipline).
+        self._wall = wall
+        #: structured degraded-resume records (missing/unreadable extra
+        #: items) — the WARN paths leave a machine-readable trail here so
+        #: launchers can fold "what did this resume silently drop" into
+        #: their run reports instead of grepping logs.
+        self.resume_events: list[dict] = []
 
     @property
     def directory(self) -> str:
@@ -438,6 +447,9 @@ class Checkpointer:
                 "checkpoint step %d at %s has no %r item (saved before "
                 "this extra existed); restoring without it", step,
                 self.directory, name)
+            self.resume_events.append({
+                "event": "missing-extra", "item": name, "step": step,
+                "t": round(self._wall(), 3)})
             return None
         try:
             return self._mgr.restore(
@@ -449,6 +461,10 @@ class Checkpointer:
                 "checkpoint step %d at %s: extra item %r is unreadable "
                 "(%s: %.200s); restoring without it", step, self.directory,
                 name, type(e).__name__, e)
+            self.resume_events.append({
+                "event": "unreadable-extra", "item": name, "step": step,
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+                "t": round(self._wall(), 3)})
             return None
 
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:

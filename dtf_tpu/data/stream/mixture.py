@@ -65,7 +65,8 @@ class MixtureStream:
 
     def __init__(self, sources: Sequence, weights: Dict[str, float],
                  global_batch: int, *, seed: int = 0, host_view=None,
-                 producer_depth: int = 0, stall_s: float = 1.0):
+                 producer_depth: int = 0, stall_s: float = 1.0,
+                 clock=time.perf_counter, sleep=time.sleep):
         if not sources:
             raise ValueError("need at least one source")
         names = [s.name for s in sources]
@@ -91,6 +92,11 @@ class MixtureStream:
                            else (0, self.global_batch))
         self.producer_depth = int(producer_depth)
         self.stall_s = float(stall_s)
+        #: injectable clock/sleep — tests drive the stall verb and the
+        #: produce_s accounting without real wall time (analysis host
+        #: pass: clock-escape)
+        self._clock = clock
+        self._sleep = sleep
         #: weight schedule: [[step, {name: weight}], ...] sorted by step;
         #: entry k applies from its step until the next entry's.
         self._schedule: List[list] = [[0, self._normalize(weights)]]
@@ -209,31 +215,41 @@ class MixtureStream:
     def produce(self, step: int) -> dict:
         """Build batch ``step`` and advance the cursors past it. Steps
         must be consumed in order (the cursor IS the order)."""
+        # the fault DECISION (read-check-set on _fault_fired, stall
+        # counter) happens under the lock; the stall itself must not —
+        # sleeping while holding the lock would block state_at/stats for
+        # the whole injected latency
+        fired = None
+        stall_for = 0.0
         with self._lock:
             if step != self._next_step:
                 raise ValueError(
                     f"produce({step}) out of order; next step is "
                     f"{self._next_step}")
             cursors = dict(self._cursors)
-        fault = self._fault
-        if (fault is not None and not self._fault_fired
-                and step >= fault.step):
-            self._fault_fired = True
-            src = self.sources[fault.source or 0]
-            if fault.kind == "stall_source":
-                self._stats["stalls"] += 1
+            fault = self._fault
+            if (fault is not None and not self._fault_fired
+                    and step >= fault.step):
+                self._fault_fired = True
+                fired = fault
+                if fault.kind == "stall_source":
+                    self._stats["stalls"] += 1
+                    stall_for = self.stall_s
+        if fired is not None:
+            src = self.sources[fired.source or 0]
+            if fired.kind == "stall_source":
                 log.warning(
                     "stream fault: stalling source %r for %.1fs at step "
                     "%d (latency-only — batches are unchanged)",
-                    src.name, self.stall_s, step)
-                time.sleep(self.stall_s)
+                    src.name, stall_for, step)
+                self._sleep(stall_for)
             elif hasattr(src, "poison_next"):
                 src.poison_next()
             else:
                 log.warning(
                     "stream fault corrupt_record targets source %r, which "
                     "has no record layer; verb ignored", src.name)
-        t0 = time.perf_counter()
+        t0 = self._clock()
         ids = self._draw(step)
         batch = self._build(step, cursors, ids)
         counts = self._counts(ids)
@@ -247,7 +263,7 @@ class MixtureStream:
                         if s < step + 1 - _KEEP_SNAPSHOTS]:
                 del self._snapshots[old]
             self._stats["batches"] += 1
-            self._stats["produce_s"] += time.perf_counter() - t0
+            self._stats["produce_s"] += self._clock() - t0
         return batch
 
     # ----------------------------------------------------- state & resume
@@ -354,10 +370,11 @@ class MixtureStream:
         """Install a :class:`dtf_tpu.fault.inject.StreamFaultPlan`."""
         if plan is not None:
             log.info("stream fault armed: %s", plan)
-        self._fault = plan
-        self._fault_fired = False
-        if stall_s is not None:
-            self.stall_s = float(stall_s)
+        with self._lock:
+            self._fault = plan
+            self._fault_fired = False
+            if stall_s is not None:
+                self.stall_s = float(stall_s)
 
     def close(self) -> None:
         self._stop.set()
@@ -386,14 +403,17 @@ class MixtureStream:
                 while not stop.is_set():
                     batch = self.produce(self.next_step)
                     while not stop.is_set():
+                        waited = 0.0
                         try:
-                            t0 = time.perf_counter()
+                            t0 = self._clock()
                             q.put(batch, timeout=0.2)
-                            self._stats["producer_blocked_s"] += (
-                                time.perf_counter() - t0)
+                            waited = self._clock() - t0
                             break
                         except queue.Full:
-                            self._stats["producer_blocked_s"] += 0.2
+                            waited = 0.2
+                        finally:
+                            with self._lock:
+                                self._stats["producer_blocked_s"] += waited
             except BaseException as e:  # noqa: BLE001 — surfaced below:
                 # a producer death must raise in the CONSUMER, not vanish
                 # in a daemon thread
@@ -404,18 +424,20 @@ class MixtureStream:
         thread.start()
         try:
             while True:
-                t0 = time.perf_counter()
+                t0 = self._clock()
                 try:
                     item = q.get(timeout=0.2)
                 except queue.Empty:
-                    self._stats["consumer_wait_s"] += (
-                        time.perf_counter() - t0)
+                    with self._lock:
+                        self._stats["consumer_wait_s"] += (
+                            self._clock() - t0)
                     if stop.is_set():
                         return      # close() ends the stream like the
                     continue        # inline iterator does, never hangs
-                self._stats["consumer_wait_s"] += time.perf_counter() - t0
-                self._stats["queue_depth_max"] = max(
-                    self._stats["queue_depth_max"], q.qsize() + 1)
+                with self._lock:
+                    self._stats["consumer_wait_s"] += self._clock() - t0
+                    self._stats["queue_depth_max"] = max(
+                        self._stats["queue_depth_max"], q.qsize() + 1)
                 if isinstance(item, BaseException):
                     raise item
                 yield item

@@ -24,6 +24,7 @@ jax import, so the package fence holds.
 from __future__ import annotations
 
 import logging
+import time
 
 log = logging.getLogger("dtf_tpu")
 
@@ -38,9 +39,16 @@ class StreamCheckpointHook:
 
     telemetry_bucket = "checkpoint"
 
-    def __init__(self, ckpt, stream):
+    def __init__(self, ckpt, stream, *, wall=time.time):
         self.ckpt = ckpt
         self.stream = stream
+        #: injectable wall clock for :attr:`resume_events` stamps (the
+        #: host pass's clock-escape discipline; tests pin it).
+        self._wall = wall
+        #: structured degraded-resume records, mirroring
+        #: ``Checkpointer.resume_events`` — the legacy fast-forward WARN
+        #: leaves a machine-readable trail for run reports.
+        self.resume_events: list = []
         if ckpt is not None:
             ckpt.add_extra_provider(EXTRA_ITEM, stream.state_at)
 
@@ -57,6 +65,9 @@ class StreamCheckpointHook:
                 "legacy run); fast-forwarding the mixture by replaying "
                 "its draws to step %d — live reweights from the old run, "
                 "if any, are lost", step, step)
+            self.resume_events.append({
+                "event": "legacy-stream-seek", "step": step,
+                "t": round(self._wall(), 3)})
             self.stream.seek(step)
             return
         self.stream.restore(saved)

@@ -40,6 +40,8 @@ import os
 import time
 from typing import Callable, Mapping, Optional, Sequence
 
+from dtf_tpu._hostio import append_line
+
 
 def read_heartbeat(path: str) -> Optional[dict]:
     """The host's last liveness record, or None. Never raises — a torn
@@ -228,10 +230,8 @@ class RunController:
         except Exception:   # noqa: BLE001 — an emit sink must not kill
             pass            # the supervision loop
         try:
-            os.makedirs(self.logdir, exist_ok=True)
-            with open(os.path.join(self.logdir, "controller.jsonl"),
-                      "a") as f:
-                f.write(line + "\n")
+            append_line(os.path.join(self.logdir, "controller.jsonl"),
+                        line)
         except OSError:
             pass
         return rec

@@ -264,6 +264,7 @@ class FaultHook:
             self._emit(json.dumps({
                 "fault_inject": what, "kind": self.plan.kind,
                 "step": self.plan.step, "host": self.host_index,
+                # clock-ok: real wall stamp correlated with controller logs
                 "pid": os.getpid(), "t": round(time.time(), 3)}))
         except Exception:   # noqa: BLE001 — injection reporting must not
             pass            # alter the scenario under test
@@ -330,6 +331,9 @@ class FaultHook:
             # controller's SIGKILL to clear — sleep in short quanta so
             # the process stays signal-responsive for the dump chain.
             while True:
+                # the wedge must burn REAL wall time — it is the thing
+                # the watchdog's stall detection measures
+                # clock-ok: a real wedge sleeps on the real clock
                 time.sleep(self.WEDGE_POLL_S)
 
     def end(self, state) -> None: ...
@@ -362,9 +366,11 @@ def _corrupt_tree(root: str, mode: str, min_bytes: int) -> list[str]:
             if size < min_bytes:
                 continue
             if mode == "truncate":
+                # io-ok: deliberately non-atomic — this IS the damage
                 with open(path, "r+b") as f:
                     f.truncate(size // 2)
             else:
+                # io-ok: deliberately non-atomic — this IS the damage
                 with open(path, "r+b") as f:
                     f.write(b"\xde\xad\xbe\xef" * 4)
             touched.append(os.path.relpath(path, root))

@@ -19,6 +19,7 @@ from typing import Any, Mapping
 
 import jax
 
+from dtf_tpu._hostio import atomic_replace
 from dtf_tpu.checkpoint import Checkpointer
 from dtf_tpu.metrics import MetricWriter
 
@@ -520,9 +521,9 @@ class ProfilerHook(Hook):
             report = profile_mod.parse_logdir(
                 self.logdir, site_map=site_map, **kw)
             path = os.path.join(self.logdir, "device_profile.json")
-            os.makedirs(self.logdir, exist_ok=True)
-            with open(path, "w") as f:
-                json.dump(report, f, indent=1)
+            # atomic: bench_profile and the report CLI read this file
+            # from other processes while windows keep closing
+            atomic_replace(path, json.dumps(report, indent=1))
         except Exception as e:  # noqa: BLE001 — see docstring
             report = {"degraded": f"profile parse failed: "
                                   f"{type(e).__name__}: {e}"}

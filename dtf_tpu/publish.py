@@ -43,6 +43,7 @@ import os
 import time
 from typing import Any, Optional
 
+from dtf_tpu._hostio import atomic_replace
 from dtf_tpu.checkpoint import Checkpointer
 
 PyTree = Any
@@ -101,8 +102,12 @@ class ParamPublisher:
     manifest history keeps digests for everything still on disk).
     """
 
-    def __init__(self, directory: str, *, keep: int = 3):
+    def __init__(self, directory: str, *, keep: int = 3, wall=time.time):
         self.directory = os.fspath(directory)
+        #: injectable wall clock for the manifest's ``published_t`` stamp
+        #: (replay-stable publish tests pin it; the host pass's
+        #: clock-escape fence is why it is a parameter, not a call)
+        self.wall = wall
         os.makedirs(self.directory, exist_ok=True)
         self._ckpt = Checkpointer(self.directory, max_to_keep=keep,
                                   async_save=False)
@@ -157,14 +162,13 @@ class ParamPublisher:
         for v in sorted(history, key=int)[:-HISTORY_KEEP]:
             del history[v]
         manifest = {"schema": 1, "version": version, "step": int(step),
-                    "digest": digest, "published_t": round(time.time(), 3),
+                    "digest": digest, "published_t": round(self.wall(), 3),
                     "history": history}
         path = os.path.join(self.directory, MANIFEST_BASENAME)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(manifest, f, indent=1, sort_keys=True)
-            f.write("\n")
-        os.replace(tmp, path)       # THE commit point — atomic
+        # THE commit point — atomic (tmp + os.replace inside the choke
+        # point; a crash anywhere above leaves the old manifest serving)
+        atomic_replace(path, json.dumps(manifest, indent=1,
+                                        sort_keys=True) + "\n")
         self.published += 1
         log.info("published params version %d (train step %d) to %s",
                  version, step, self.directory)

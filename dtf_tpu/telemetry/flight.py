@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Mapping, Optional
 
+from dtf_tpu._hostio import append_line, atomic_replace
 from dtf_tpu.metrics import quantile
 
 
@@ -147,11 +148,7 @@ class FlightRecorder:
         if extra:
             rec.update(extra)
         try:
-            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                f.write(json.dumps(rec))
-            os.replace(tmp, path)
+            atomic_replace(path, json.dumps(rec))
         except OSError:
             pass
 
@@ -188,9 +185,7 @@ class FlightRecorder:
             self.dumps += 1
         if self.path:
             try:
-                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
-                with open(self.path, "a") as f:
-                    f.write(json.dumps(post) + "\n")
+                append_line(self.path, json.dumps(post))
             except OSError:
                 pass
         return post

@@ -32,6 +32,7 @@ from __future__ import annotations
 import json
 from typing import Mapping, Optional, Sequence
 
+from dtf_tpu._hostio import atomic_replace
 from dtf_tpu.telemetry.xplane import OpEvent, StepWindow, TraceData
 
 #: collective opcode prefixes (async -start/-done forms ride the prefix);
@@ -333,6 +334,5 @@ def export_chrome_trace(path: str, *, trace: Optional[TraceData] = None,
         doc["traceEvents"] += chrome_trace_events(trace)
     if request_events:
         doc["traceEvents"] += [dict(e) for e in request_events]
-    with open(path, "w") as f:
-        json.dump(doc, f)
+    atomic_replace(path, json.dumps(doc))
     return doc

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Mapping, Optional
 
+from dtf_tpu._hostio import atomic_replace
 from dtf_tpu.telemetry.accounting import (GoodputTracker,
                                           V5E_PEAK_BF16_FLOPS)
 from dtf_tpu.telemetry.fence import CompileFence
@@ -314,6 +315,7 @@ def merge_artifact(path: str, report: Mapping, *, keep_runs: int = 20,
     if meta:
         entry.update(meta)
     data["runs"] = (data["runs"] + [entry])[-keep_runs:]
-    with open(path, "w") as f:
-        json.dump(data, f, indent=1)
+    # atomic: sibling tooling (bench fences, the sentinel's pathspec
+    # commits) reads the artifact while runs append to it
+    atomic_replace(path, json.dumps(data, indent=1))
     return data

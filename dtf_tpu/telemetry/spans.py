@@ -41,8 +41,12 @@ class SpanRecorder:
     quantiles are computed over the last ``keep`` samples per phase.
     """
 
-    def __init__(self, keep: int = DEFAULT_KEEP):
+    def __init__(self, keep: int = DEFAULT_KEEP, *,
+                 clock=time.perf_counter):
         self._keep = keep
+        #: injectable monotonic clock (tests assert exact span totals
+        #: without real sleeps; analysis host pass: clock-escape)
+        self._clock = clock
         self._samples: dict[str, collections.deque] = {}
         self._totals: dict[str, float] = {}
         self._counts: dict[str, int] = {}
@@ -59,11 +63,11 @@ class SpanRecorder:
 
     @contextmanager
     def span(self, name: str) -> Iterator[None]:
-        t0 = time.perf_counter()
+        t0 = self._clock()
         try:
             yield
         finally:
-            self.add(name, time.perf_counter() - t0)
+            self.add(name, self._clock() - t0)
 
     def total(self, name: str) -> float:
         return self._totals.get(name, 0.0)

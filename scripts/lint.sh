@@ -6,7 +6,8 @@
 #
 #   scripts/lint.sh             # lint dtf_tpu/ + scripts/ + tests/
 #   scripts/lint.sh --analyze   # + the static analyzer's cheap passes
-#                               #   (specs,jaxpr,collective — no compiles)
+#                               #   (host,specs,jaxpr,collective — no
+#                               #   compiles)
 #   scripts/lint.sh --full      # + the WHOLE analyzer (all passes incl.
 #                               #   the AOT comms-budget fence AND the
 #                               #   memory pass: HBM breakdown fence,
@@ -74,8 +75,8 @@ rc=$?
 [ $rc -ne 0 ] && exit $rc
 
 if [ "$ANALYZE" = "1" ]; then
-  echo "lint: dtf_tpu.analysis (specs,jaxpr,collective)"
-  python -m dtf_tpu.analysis --passes=specs,jaxpr,collective
+  echo "lint: dtf_tpu.analysis (host,specs,jaxpr,collective)"
+  python -m dtf_tpu.analysis --passes=host,specs,jaxpr,collective
   rc=$?
 fi
 
