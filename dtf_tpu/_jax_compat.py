@@ -38,6 +38,18 @@ import jax
 BACKFILLED = False
 
 
+def fp8_e4m3_dtype():
+    """The fp8 e4m3 storage dtype, or None on a jax without fp8 support.
+
+    The low-precision matmul tier (``ops/quant.py``) feature-gates its
+    fp8 path here: where the dtype is missing, an fp8 precision request
+    demotes to bf16 with one warning instead of crashing a launcher on
+    an old install (docs/TUNING.md "Precision winners")."""
+    import jax.numpy as jnp
+
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
 def _install() -> None:
     global BACKFILLED
     BACKFILLED = not hasattr(jax, "shard_map")

@@ -95,6 +95,13 @@ def _fit_main(argv: list[str]) -> int:
                         help="train: hosts lost; the survivor mesh "
                              "(data axis scaled down) is priced at the "
                              "SAME global batch next to the full mesh")
+    parser.add_argument("--precision", default=None,
+                        choices=("int8", "fp8"),
+                        help="price the low-precision tier next to bf16: "
+                             "serve configs report max_slots with 8-bit "
+                             "weights (+ per-channel scale sideband), "
+                             "train configs the activation-temp shrink "
+                             "(docs/ANALYSIS.md, docs/TUNING.md)")
     args = parser.parse_args(argv)
 
     from dtf_tpu.analysis import configs as cfgs
@@ -110,7 +117,8 @@ def _fit_main(argv: list[str]) -> int:
             args.config, hbm_gb=args.hbm_gb, max_len=args.max_len,
             kv_page_size=args.kv_page_size, slots=args.slots, opt=args.opt,
             grad_accum=args.grad_accum, grad_shard=args.grad_shard,
-            act_scale=args.act_scale, hosts=args.hosts, lost=args.lost)
+            act_scale=args.act_scale, hosts=args.hosts, lost=args.lost,
+            precision=args.precision)
     except Exception as e:  # noqa: BLE001 — last line must still be JSON
         print(json.dumps({"ok": False,
                           "error": f"{type(e).__name__}: {e}"[:500]}))

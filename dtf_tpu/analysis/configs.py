@@ -502,6 +502,17 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    # swap — TP-layer all-gather/reduce-scatter traffic
                    # becomes collective-permute rings (docs/OVERLAP.md).
                    allow_dead=(r"w_(in|out)$",)),
+    AnalysisConfig("gpt_overlap_q8",
+                   MeshConfig(data=2, seq=2, model=2),
+                   _gpt_spec(tp_overlap=True, matmul_precision="int8"),
+                   _gpt_step(tp_overlap=True, matmul_precision="int8"),
+                   # quantized-operand rings (ISSUE 17): same ppermute
+                   # collectives as gpt_overlap, but each FORWARD ring
+                   # hop carries the int8 payload + f32 scale sideband
+                   # instead of the full-width tensor — the fence pins
+                   # the byte shrink exactly (backward rings stay
+                   # full-precision: master weights). docs/TUNING.md.
+                   allow_dead=(r"w_(in|out)$",)),
     AnalysisConfig("gpt_moe", MeshConfig(data=4, expert=2),
                    _gpt_spec(moe_every=2), _gpt_step(moe_every=2)),
     AnalysisConfig("gpt_serve", MeshConfig(data=4, model=2),

@@ -516,8 +516,46 @@ def _price_spec_tree(tree: PyTree, specs: PyTree, mesh) -> int:
     return total
 
 
+#: the low-precision storage widths ``fit --precision`` prices, straight
+#: from the hlo.py bit-width table (s8 / f8e4m3fn are both 8 bits).
+_PRECISION_BITS = {"int8": 8, "fp8": 8}
+
+
+def _quant_params_bytes(tree: PyTree, specs: PyTree, mesh,
+                        precision: str) -> int:
+    """Per-device bytes of a param tree with every matrix leaf (ndim>=2)
+    stored at ``precision`` width plus its per-channel f32 scale sideband
+    (one scale per output channel — the ops/quant.py layout: quantize
+    over the contraction axis 0, scale shape (1,) + shape[1:]). Vector
+    leaves (biases, layernorm gains) stay at their own dtype — they are
+    noise next to the matrices and the quant tier never touches them."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    bits = _PRECISION_BITS[precision]
+    mesh_shape = dict(mesh.shape)
+    total = 0
+
+    def one(spec, leaf):
+        nonlocal total
+        if len(leaf.shape) >= 2:
+            total += _spec_device_bytes(leaf.shape, np.dtype(np.int8),
+                                        spec, mesh_shape) * bits // 8
+            total += _spec_device_bytes((1,) + tuple(leaf.shape[1:]),
+                                        np.dtype(np.float32), spec,
+                                        mesh_shape)
+        else:
+            total += _spec_device_bytes(leaf.shape, leaf.dtype, spec,
+                                        mesh_shape)
+        return spec
+
+    jax.tree.map(one, specs, tree, is_leaf=lambda x: isinstance(x, P))
+    return total
+
+
 def _fit_serve(config, hbm_bytes: int, *, max_len: int, kv_page_size: int,
-               slots: Optional[int]) -> dict:
+               slots: Optional[int],
+               precision: Optional[str] = None) -> dict:
     """Real-scale serve planning: params + per-slot KV + page pool,
     priced via ``eval_shape`` only (no compile).  Reports bf16 AND int8
     KV side by side — the two serving memory levers the engine ships."""
@@ -537,6 +575,16 @@ def _fit_serve(config, hbm_bytes: int, *, max_len: int, kv_page_size: int,
         "max_len": max_len, "kv_page_size": kv_page_size, "kv": {},
     }
     avail = hbm_bytes - params_dev
+    avail_q = None
+    if precision is not None:
+        # --precision: weights held at 8-bit (matrix leaves + per-channel
+        # scale sideband, the ops/quant.py layout) — the HBM the
+        # quantized tier frees buys extra slots on the same chip.
+        qparams_dev = _quant_params_bytes(spec_view.params, param_specs,
+                                          mesh, precision)
+        out["precision"] = precision
+        out["params_bytes_per_device_at_precision"] = qparams_dev
+        avail_q = hbm_bytes - qparams_dev
 
     # speculative decoding (fit_draft_cfg): the draft model is RESIDENT
     # state too — its params (priced under the same TP rules) and one
@@ -578,6 +626,10 @@ def _fit_serve(config, hbm_bytes: int, *, max_len: int, kv_page_size: int,
             "page_bytes_per_device": per_page,
             "max_slots": max_slots,
         }
+        if avail_q is not None:
+            q_slots = int(avail_q // per_slot) if avail_q > 0 else 0
+            q_slots -= q_slots % data_size
+            row["max_slots_at_precision"] = q_slots
         if slots is not None:
             left = avail - slots * per_slot
             row["slots"] = slots
@@ -594,6 +646,17 @@ def _fit_serve(config, hbm_bytes: int, *, max_len: int, kv_page_size: int,
             row["draft_kv_bytes_per_slot_per_device"] = int(
                 round(per_slot_draft))
             row["max_slots_with_spec"] = max_spec
+            if avail_q is not None:
+                # the quantized-DRAFT deployment (serve_gpt
+                # --draft_precision): target weights stay bf16, the
+                # draft's matrices go 8-bit.
+                qdraft_dev = _quant_params_bytes(dparams, dspecs, mesh,
+                                                 precision)
+                sq = avail - qdraft_dev
+                mq = (int(sq // (per_slot + per_slot_draft))
+                      if sq > 0 else 0)
+                mq -= mq % data_size
+                row["max_slots_with_spec_at_draft_precision"] = mq
         out["kv"][kv_name] = row
     return out
 
@@ -608,7 +671,8 @@ def _scale_batch(batch: PyTree, b: int) -> PyTree:
 
 def _fit_train(config, hbm_bytes: int, *, opt: Optional[str],
                grad_accum: int, grad_shard: bool,
-               act_scale: Optional[float], mesh=None) -> dict:
+               act_scale: Optional[float], mesh=None,
+               precision: Optional[str] = None) -> dict:
     """Train planning: analytic resident state + a measured affine
     temp-vs-batch model (two AOT compiles of the registry's own tiny
     program).  The batch inversion answers at PROGRAM scale — the same
@@ -678,7 +742,7 @@ def _fit_train(config, hbm_bytes: int, *, opt: Optional[str],
     # batch?") is this number on the shrunk mesh vs the budget.
     need_at_b0 = int(resident["total_bytes"] + intercept * scale
                      + per_row * b0)
-    return {
+    out = {
         "scale": label, "opt": opt_name,
         "grad_accum": grad_accum, "grad_shard": grad_shard,
         "mesh": dict(mesh.shape),
@@ -692,6 +756,28 @@ def _fit_train(config, hbm_bytes: int, *, opt: Optional[str],
         "fits_at_batch": bool(need_at_b0 <= hbm_bytes),
         "max_global_batch": max(0, max_batch),
     }
+    if precision is not None:
+        # --precision on a train config: the RESIDENT side is unchanged
+        # by design (bf16/f32 master weights, full-precision grads — the
+        # quant tier quantizes compute and ring bytes, not state), so
+        # only the activation-temp slope shrinks: scaled by 8 bits over
+        # the program's own activation width. A documented ESTIMATE —
+        # the 8-bit activations live inside fusions XLA shapes as it
+        # pleases — bounded below by the measured bf16 row it sits next
+        # to (docs/ANALYSIS.md §fit).
+        import jax as _jax
+
+        act_bits = 8 * _jax.tree.leaves(view.state.params)[0].dtype.itemsize
+        q_ratio = _PRECISION_BITS[precision] / act_bits
+        q_per_row = slope * scale * q_ratio + batch_row * scale
+        q_max = (int(avail // q_per_row)
+                 if q_per_row > 0 and avail > 0 else 0)
+        q_max -= q_max % grain
+        out["precision"] = precision
+        out["temp_model"]["bytes_per_batch_row_at_precision"] = int(
+            round(q_per_row))
+        out["max_global_batch_at_precision"] = max(0, q_max)
+    return out
 
 
 def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
@@ -699,7 +785,8 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
         opt: Optional[str] = None, grad_accum: int = 1,
         grad_shard: bool = False,
         act_scale: Optional[float] = None,
-        hosts: Optional[int] = None, lost: int = 0) -> dict:
+        hosts: Optional[int] = None, lost: int = 0,
+        precision: Optional[str] = None) -> dict:
     """The fit planner: what fits a ``hbm_gb``-HBM chip under config
     ``name``'s mesh and sharding rules.  Serve configs answer max KV
     slots (bf16 AND int8) + page-pool size from a pure ``eval_shape``
@@ -719,6 +806,10 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
     from dtf_tpu.analysis import configs as cfgs
 
     config = cfgs.BY_NAME[name]
+    if precision is not None and precision not in _PRECISION_BITS:
+        raise ValueError(
+            f"precision={precision!r} must be one of "
+            f"{sorted(_PRECISION_BITS)} (bf16 is the default pricing)")
     hbm_bytes = int(hbm_gb * (1 << 30))
     out = {"mode": "fit", "config": name, "hbm_gb": hbm_gb,
            "mesh": dict(config.mesh().shape)}
@@ -741,7 +832,7 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
         surv_mesh = make_mesh(MeshConfig(**surv_shape),
                               devices=jax.devices()[:n_surv])
         kw = dict(opt=opt, grad_accum=grad_accum, grad_shard=grad_shard,
-                  act_scale=act_scale)
+                  act_scale=act_scale, precision=precision)
         out.update({
             "kind": "train_shrink", "hosts": hosts, "lost": lost,
             "survivor_mesh": surv_shape,
@@ -754,10 +845,11 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
     if config.fit_serve_cfg is not None:
         out["kind"] = "serve"
         out.update(_fit_serve(config, hbm_bytes, max_len=max_len,
-                              kv_page_size=kv_page_size, slots=slots))
+                              kv_page_size=kv_page_size, slots=slots,
+                              precision=precision))
     else:
         out["kind"] = "train"
         out.update(_fit_train(config, hbm_bytes, opt=opt,
                               grad_accum=grad_accum, grad_shard=grad_shard,
-                              act_scale=act_scale))
+                              act_scale=act_scale, precision=precision))
     return out

@@ -192,6 +192,7 @@ def lint_file(path: str) -> list[str]:
     blessed_block_module = bool(dirs) and dirs[-1] in ("ops", "tune")
     if not (blessed_block_module or in_tests):
         problems += _block_literals(tree, path, noqa)
+        problems += _precision_literals(tree, path, noqa)
 
     # ---- raw ppermute perm lists (must come from the named builders) ----
     blessed_perm_module = (
@@ -321,6 +322,49 @@ def _block_literals(tree, path: str, noqa: set) -> list:
                     f"the banked winner (dtf_tpu/tune, KERNEL_TUNE.json; "
                     f"docs/TUNING.md), or mark a deliberate pin with "
                     f"'# noqa: <why>'")
+    return problems
+
+
+#: the tp_dense/ring entry points whose ``precision`` kwarg the tuner
+#: owns (ISSUE 17), and the literal values that stay legal anywhere:
+#: "" (bf16 status quo) and "auto" (resolver decides). A hard-coded
+#: "int8"/"fp8" outside ops//tune/ (and tests) bypasses the measured
+#: quality bound exactly the way a block-shape literal bypasses the
+#: banked block winner — same fence, string edition.
+_PRECISION_CALLS = ("tp_dense", "TpDense", "quantized_matmul",
+                    "ag_matmul_quant_sharded", "matmul_rs_quant_sharded")
+_PRECISION_FREE_LITERALS = ("", "auto")
+
+
+def _precision_literals(tree, path: str, noqa: set) -> list:
+    """String precision literals other than ''/'auto' at tp_dense / ring
+    call sites — launchers and models must pass '' (bf16), 'auto' (the
+    kernel-tune winner), or thread a resolved variable (e.g.
+    ``precision=cfg.matmul_precision``, which is an Attribute, not a
+    Constant, and passes untouched). A deliberate pin carries
+    ``# noqa`` with its why."""
+    problems = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and node.lineno not in noqa):
+            continue
+        fn = node.func
+        fn_name = (fn.id if isinstance(fn, ast.Name)
+                   else fn.attr if isinstance(fn, ast.Attribute) else None)
+        if fn_name not in _PRECISION_CALLS:
+            continue
+        for kw in node.keywords:
+            if (kw.arg == "precision"
+                    and isinstance(kw.value, ast.Constant)
+                    and isinstance(kw.value.value, str)
+                    and kw.value.value not in _PRECISION_FREE_LITERALS
+                    and kw.value.lineno not in noqa):
+                problems.append(
+                    f"{path}:{kw.value.lineno}: precision literal "
+                    f"{kw.value.value!r} at a {fn_name} call — pass '' "
+                    f"(bf16), 'auto' (the kernel-tune winner under its "
+                    f"rel-err ceiling), or a resolved variable "
+                    f"(dtf_tpu/tune, KERNEL_TUNE.json; docs/TUNING.md), "
+                    f"or mark a deliberate pin with '# noqa: <why>'")
     return problems
 
 

@@ -331,7 +331,8 @@ def tp_activation_gathered(x: jax.Array, mesh: Mesh | None) -> jax.Array:
 
 def tp_dense(x: jax.Array, kernel: jax.Array, bias: jax.Array | None,
              mesh: Mesh | None, *, parallel: str, overlap: bool = False,
-             dtype=None, axis: str = "model") -> jax.Array:
+             dtype=None, axis: str = "model",
+             precision: str = "") -> jax.Array:
     """Apply one Megatron TP projection — THE dispatch point the models
     route through (srclint fences direct ``jax.lax`` collectives out of
     ``models/``; see docs/OVERLAP.md).
@@ -343,6 +344,15 @@ def tp_dense(x: jax.Array, kernel: jax.Array, bias: jax.Array | None,
     rings of :mod:`dtf_tpu.ops.collective_matmul` when
     :func:`tp_overlap_viable`; otherwise this is exactly the einsum
     ``nn.Dense`` performs and GSPMD schedules the (blocking) collectives.
+
+    ``precision`` is the low-precision compute tier (docs/TUNING.md):
+    ``""`` = bf16 status quo (no tuner consult), ``"auto"`` = the banked
+    kernel-tune winner for this (parallel, shape) site, explicit
+    ``"int8"``/``"fp8"`` = quantized compute with bf16 master weights
+    (wins over a measured winner with one WARN). On the ring path the
+    COMMUNICATED operand is quantized (dequant-after-ppermute, ~2x fewer
+    ring bytes); off it, :func:`dtf_tpu.ops.quant.quantized_matmul` runs
+    the low-precision dot. Gradients stay full-precision either way.
     """
     if parallel not in ("column", "row"):
         raise ValueError(f"parallel={parallel!r} must be 'column' or 'row'")
@@ -350,15 +360,35 @@ def tp_dense(x: jax.Array, kernel: jax.Array, bias: jax.Array | None,
         x = x.astype(dtype)
         kernel = kernel.astype(dtype)
         bias = bias.astype(dtype) if bias is not None else None
+    resolved = "bf16"
+    if precision:
+        from dtf_tpu.ops import quant
+
+        resolved = quant.resolve_precision(
+            precision, parallel=parallel, d_in=kernel.shape[0],
+            d_out=kernel.shape[1], dtype=str(jnp.dtype(x.dtype)),
+            n_devices=(mesh.devices.size if mesh is not None else 1))
     if overlap and tp_overlap_viable(
             x.shape, kernel.shape[0], kernel.shape[1], mesh,
             parallel=parallel, axis=axis):
         from dtf_tpu.ops import collective_matmul as cm
 
         if parallel == "column":
-            y = cm.ag_matmul_sharded(x, kernel, mesh, axis=axis)
+            if resolved == "bf16":
+                y = cm.ag_matmul_sharded(x, kernel, mesh, axis=axis)
+            else:
+                y = cm.ag_matmul_quant_sharded(x, kernel, mesh, axis=axis,
+                                               precision=resolved)
         else:
-            y = cm.matmul_rs_sharded(x, kernel, mesh, axis=axis)
+            if resolved == "bf16":
+                y = cm.matmul_rs_sharded(x, kernel, mesh, axis=axis)
+            else:
+                y = cm.matmul_rs_quant_sharded(x, kernel, mesh, axis=axis,
+                                               precision=resolved)
+    elif resolved != "bf16":
+        from dtf_tpu.ops import quant
+
+        y = quant.quantized_matmul(x, kernel, precision=resolved)
     else:
         y = jnp.einsum("...td,df->...tf", x, kernel)
     return y if bias is None else y + bias
@@ -379,6 +409,7 @@ class TpDense(nn.Module):
     use_bias: bool = True
     dtype: Any = None
     param_dtype: Any = jnp.float32
+    precision: str = ""           # '' | 'auto' | 'bf16' | 'int8' | 'fp8'
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -388,7 +419,8 @@ class TpDense(nn.Module):
                            (self.features,), self.param_dtype)
                 if self.use_bias else None)
         return tp_dense(x, kernel, bias, self.mesh, parallel=self.parallel,
-                        overlap=self.overlap, dtype=self.dtype)
+                        overlap=self.overlap, dtype=self.dtype,
+                        precision=self.precision)
 
 
 # ---------------------------------------------------------------------------

@@ -120,6 +120,16 @@ class GPTConfig:
     #: numerics parity with the GSPMD path; no-op unless the mesh has a
     #: real 'model' axis and shapes divide (comms.tp_overlap_viable).
     tp_overlap: bool = False
+    #: low-precision compute tier for the TP projections (docs/TUNING.md):
+    #: "" = bf16 status quo (no tuner consult), "auto" = the banked
+    #: kernel-tune winner per projection site, "int8"/"fp8" = explicit pin
+    #: (wins with one WARN over a measured winner). Forward-only: the
+    #: custom_vjp keeps gradients full-precision against bf16 master
+    #: weights, and on the tp_overlap rings the COMMUNICATED operand is
+    #: what quantizes (~2x fewer ring bytes). The serving draft engine is
+    #: the first consumer (serve_gpt --draft_precision): the bf16
+    #: verifier keeps emitted tokens byte-identical regardless.
+    matmul_precision: str = ""
 
     def __post_init__(self):
         if self.kv_heads is not None and (
@@ -138,6 +148,12 @@ class GPTConfig:
             raise ValueError(
                 f"kv_cache_dtype={self.kv_cache_dtype!r} must be '' (store "
                 "at dtype) or 'int8'")
+        if self.matmul_precision not in ("", "auto", "bf16", "int8",
+                                         "fp8"):
+            raise ValueError(
+                f"matmul_precision={self.matmul_precision!r} must be '' "
+                "(bf16, no tuner), 'auto' (kernel-tune winner), 'bf16', "
+                "'int8' or 'fp8'")
         if self.slot_decode and self.decode_len <= 0:
             raise ValueError(
                 "slot_decode requires decode_len > 0 (it is a property of "
@@ -434,10 +450,11 @@ class CausalSelfAttention(nn.Module):
                    and not self.manual_seq)
         dense = lambda name, nh: comms.TpDense(  # noqa: E731
             nh * d_head, self.mesh, "column", overlap=overlap,
-            dtype=cfg.dtype, name=name)
+            dtype=cfg.dtype, precision=cfg.matmul_precision, name=name)
         out_dense = lambda: comms.TpDense(  # noqa: E731
             cfg.d_model, self.mesh, "row", overlap=overlap,
-            dtype=cfg.dtype, name="attn_out")
+            dtype=cfg.dtype, precision=cfg.matmul_precision,
+            name="attn_out")
 
         def split(v, nh):
             return v.reshape(v.shape[0], t, nh, d_head).transpose(0, 2, 1, 3)
@@ -781,10 +798,12 @@ class Block(nn.Module):
             # residual stream stays token-sharded over ('seq','model'))
             y = comms.TpDense(cfg.d_ff, self.mesh, "column",
                               overlap=overlap, dtype=cfg.dtype,
+                              precision=cfg.matmul_precision,
                               name="mlp_in")(h)
             y = nn.gelu(y, approximate=True)
             y = comms.TpDense(cfg.d_model, self.mesh, "row",
                               overlap=overlap, dtype=cfg.dtype,
+                              precision=cfg.matmul_precision,
                               name="mlp_out")(y)
         y = nn.Dropout(cfg.dropout)(y, deterministic=deterministic)
         if overlap:

@@ -88,6 +88,21 @@ class SpecKPlan:
     measured: bool
 
 
+#: matmul precision when no winner is banked: bf16 — the status-quo
+#: numerics. Low precision only ever turns ON from banked data (a row
+#: that beat bf16 on time AND passed the rel-err ceiling at selection,
+#: ``search.select_precision_winner``) or an explicit pin.
+FALLBACK_PRECISION = "bf16"
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPlan:
+    #: "bf16" | "int8" | "fp8" — what tp_dense should actually run.
+    precision: str
+    source: str
+    measured: bool
+
+
 @functools.lru_cache(maxsize=1024)
 def flash_plan(*, seq: int, heads: int, head_dim: int, dtype: str,
                causal: bool, window: int, n_devices: int = 1,
@@ -172,6 +187,31 @@ def spec_k_plan(*, model: str, draft: str, n_slots: int,
                      measured=e.measured)
 
 
+@functools.lru_cache(maxsize=512)
+def matmul_precision_plan(*, parallel: str, d_in: int, d_out: int,
+                          dtype: str, n_devices: int = 1,
+                          backend: Optional[str] = None) -> PrecisionPlan:
+    """The tuned compute precision for one ``tp_dense`` projection site —
+    ``precision='auto'`` resolves here; an explicit ``--matmul_precision``
+    wins with a warn-once when it overrides a measured winner
+    (``ops/quant.resolve_precision`` calls ``note_override``).
+
+    ``site``/``parallel`` are hard-matched (a winner measured for the
+    column ring never resolves for the row ring — different error
+    model); d_in/d_out are soft (nearest shape), dtype adds the usual
+    small penalty. The quality bound is enforced at SELECTION time
+    (``search.select_precision_winner`` drops rows whose banked rel-err
+    exceeds the ceiling), so any entry that resolves here already passed
+    it — the plan just reports the winner."""
+    key = dict(site="tp_dense", parallel=parallel, d_in=d_in, d_out=d_out,
+               dtype=dtype, n_devices=n_devices, backend=backend)
+    e = _cache.load_store().lookup("matmul_precision", key)
+    if e is None or "precision" not in e.winner:
+        return PrecisionPlan(FALLBACK_PRECISION, FALLBACK_SOURCE, False)
+    return PrecisionPlan(precision=str(e.winner["precision"]),
+                         source=e.source, measured=e.measured)
+
+
 @functools.lru_cache(maxsize=256)
 def _warn_override_once(kind: str, what: str, explicit: str,
                         winner: str, source: str) -> None:
@@ -201,6 +241,7 @@ def _clear_plans() -> None:
     fused_ce_plan.cache_clear()
     lm_loss_winner.cache_clear()
     spec_k_plan.cache_clear()
+    matmul_precision_plan.cache_clear()
     _warn_override_once.cache_clear()
 
 

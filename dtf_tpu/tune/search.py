@@ -49,6 +49,17 @@ FUSED_CE_CANDIDATES = ((256, 1024), (512, 512), (512, 1024), (512, 2048),
 #: shapes: AUTO_LOSS_CHUNK_TOKENS / the vocab ladder's 8192).
 LM_LOSS_CANDIDATES = (("monolithic", 0), ("chunk_tokens", 4096),
                       ("chunk_vocab", 8192), ("pallas", 0))
+#: the tp_dense precision axis bench_quant A/Bs per (parallel, shape)
+#: site. bf16 is the control every row is judged against; fp8 rows only
+#: run where the jax carries the e4m3 dtype (quant.fp8_supported).
+MATMUL_PRECISION_CANDIDATES = ("bf16", "int8", "fp8")
+#: quality ceiling a low-precision row must beat to be ELIGIBLE as a
+#: winner: Frobenius rel-err of the quantized projection output vs the
+#: bf16 control on the same seeded operands. 5e-2 is deliberately loose
+#: — per-channel symmetric int8 on activation-scale data lands ~1e-2;
+#: a row near the ceiling signals an outlier-heavy shape where low
+#: precision should NOT win (docs/TUNING.md "Precision winners").
+PRECISION_REL_ERR_CEILING = 5e-2
 
 
 def flash_fwd_candidates(seq: int) -> list[tuple[int, int]]:
@@ -88,6 +99,25 @@ def select_winner(rows: list[dict], *, metric: str,
     sign = 1.0 if lower_is_better else -1.0
     return min(live, key=lambda r: (sign * float(r[metric]),
                                     json.dumps(r, sort_keys=True)))
+
+
+def select_precision_winner(rows: list[dict]) -> Optional[dict]:
+    """The winning precision row for ONE (parallel, d_in, d_out) site:
+    fastest ``matmul_s`` among rows that pass the quality bound.
+
+    bf16 rows are exempt from the ceiling (they ARE the reference); a
+    low-precision row missing its ``rel_err`` is dropped, not trusted —
+    the bound is the whole point of tuner ownership."""
+    eligible = []
+    for r in rows:
+        if r.get("precision") == "bf16":
+            eligible.append(r)
+            continue
+        err = r.get("rel_err")
+        if isinstance(err, (int, float)) and \
+                float(err) <= PRECISION_REL_ERR_CEILING:
+            eligible.append(r)
+    return select_winner(eligible, metric="matmul_s")
 
 
 # --------------------------------------------------------------- seeding
@@ -349,6 +379,74 @@ def spec_policy_entries() -> list[Entry]:
         measured=False)]
 
 
+def seed_precision_entries(root: str) -> list[Entry]:
+    """matmul_precision winners per (parallel, d_in, d_out, dtype) site
+    from the banked bench_quant rows (KERNEL_TUNE_SWEEP.json
+    ``precision_rows``): fastest ``matmul_s`` among rows inside the
+    rel-err ceiling — a site where nothing beats bf16 banks bf16, which
+    is itself useful data (``--matmul_precision=int8`` there warns)."""
+    rows = [r for r in _read_json(
+        os.path.join(root, SWEEP_ARTIFACT)).get("precision_rows", [])
+        if r.get("parallel") and r.get("d_in") and r.get("d_out")]
+    groups: dict[tuple, list[dict]] = {}
+    for r in rows:
+        gk = (str(r["parallel"]), int(r["d_in"]), int(r["d_out"]),
+              str(r.get("dtype", "bfloat16")),
+              str(r.get("backend", "tpu")), int(r.get("n_devices", 1)))
+        groups.setdefault(gk, []).append(r)
+    entries: list[Entry] = []
+    for (parallel, d_in, d_out, dtype, backend, n_dev), brows in \
+            sorted(groups.items()):
+        best = select_precision_winner(brows)
+        if best is None:
+            continue
+        entries.append(Entry(
+            kind="matmul_precision",
+            key=dict(site="tp_dense", parallel=parallel, d_in=d_in,
+                     d_out=d_out, dtype=dtype, n_devices=n_dev,
+                     backend=backend),
+            winner={"precision": str(best["precision"]),
+                    "rel_err": best.get("rel_err")},
+            metric={"matmul_s": best.get("matmul_s"),
+                    "alternatives": {
+                        str(b["precision"]): b.get("matmul_s")
+                        for b in brows}},
+            source=("banked bench_quant precision rows "
+                    "(KERNEL_TUNE_SWEEP.json precision_rows): fastest "
+                    "matmul_s inside the rel-err ceiling "
+                    f"({PRECISION_REL_ERR_CEILING:g})"),
+            measured=True))
+    return entries
+
+
+def precision_policy_entries() -> list[Entry]:
+    """The quantized-DRAFT serving default until the on-chip precision
+    sweep banks: int8 at the gpt2_draft projection widths (384<->1536).
+    The draft's output never reaches a user — the bf16 verifier owns
+    the emitted token stream byte-for-byte (tests/test_serve_spec.py) —
+    so a draft-side quality miss costs only acceptance rate, never
+    correctness; that asymmetry is why the draft gets the first
+    low-precision win. measured=False: an explicit --draft_precision
+    never warns about overriding a guess, and the next bench_quant
+    round replaces these with timed rows at the same keys."""
+    src = ("policy default pending the queued bench_quant precision "
+           "rows (draft-side only: the bf16 verifier keeps emitted "
+           "tokens byte-identical; re-seed after rows bank)")
+
+    def _e(parallel, d_in, d_out):
+        return Entry(
+            kind="matmul_precision",
+            key=dict(site="tp_dense", parallel=parallel, d_in=d_in,
+                     d_out=d_out, dtype="bfloat16", n_devices=1,
+                     backend="tpu"),
+            winner={"precision": "int8"}, source=src, measured=False)
+
+    # gpt2_draft (d384, ff1536): qkv/attn-proj 384x384 column,
+    # mlp_in 384x1536 column, attn_out/mlp_out row back into d_model.
+    return [_e("column", 384, 384), _e("column", 384, 1536),
+            _e("row", 384, 384), _e("row", 1536, 384)]
+
+
 def cpu_sim_fallback_entries() -> list[Entry]:
     """Deterministic CPU-sim entries mirroring the built-in defaults.
 
@@ -381,6 +479,7 @@ def seed_entries(root: Optional[str] = None) -> list[Entry]:
     # policy entries FIRST: merge_entries is last-wins per canonical key,
     # so a measured spec_k row banking at the policy's exact key replaces
     # the guess instead of being shadowed by it.
-    return (spec_policy_entries() + seed_flash_entries(root)
-            + seed_lm_loss_entries(root) + seed_spec_k_entries(root)
+    return (spec_policy_entries() + precision_policy_entries()
+            + seed_flash_entries(root) + seed_lm_loss_entries(root)
+            + seed_spec_k_entries(root) + seed_precision_entries(root)
             + cpu_sim_fallback_entries())

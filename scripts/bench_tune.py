@@ -59,6 +59,29 @@ CPU_SHAPE = {"name": "cpu_sim", "seq": 128, "b": 1, "h": 2, "d": 32}
 CPU_FWD_CANDIDATES = ((64, 64), (128, 128))
 CPU_BWD_CANDIDATES = ((64, 128),)
 
+#: matmul-precision A/B cells (bench_quant children): bf16/int8/fp8 at
+#: the tp_dense sites — the GPT-2-small flagship's four projections and
+#: the gpt2_draft twin (the shapes the serving draft actually runs).
+#: Rows land under KERNEL_TUNE_SWEEP.json "precision_rows" and seed the
+#: matmul_precision winners (quality-bounded: see
+#: search.select_precision_winner).
+QUANT_SENTINEL = "QUANT_ROW "
+PRECISION_SITES = (
+    {"parallel": "column", "d_in": 768, "d_out": 768},
+    {"parallel": "column", "d_in": 768, "d_out": 3072},
+    {"parallel": "row", "d_in": 768, "d_out": 768},
+    {"parallel": "row", "d_in": 3072, "d_out": 768},
+    {"parallel": "column", "d_in": 384, "d_out": 384},
+    {"parallel": "column", "d_in": 384, "d_out": 1536},
+    {"parallel": "row", "d_in": 384, "d_out": 384},
+    {"parallel": "row", "d_in": 1536, "d_out": 384},
+)
+PRECISION_CANDIDATES = ("bf16", "int8", "fp8")
+#: CPU wiring-check cell (interpret-grade timing, never banked to the
+#: committed sweep artifact — not MXU-predictive).
+CPU_PRECISION_SITES = ({"parallel": "column", "d_in": 16, "d_out": 32},)
+CPU_PRECISION_CANDIDATES = ("bf16", "int8")
+
 #: loss-path A/B jobs (bench_lm children): rows land under
 #: BENCH_LM.json "loss_path" and seed the lm_loss winners.
 LOSS_PATH_JOBS = (
@@ -162,6 +185,80 @@ def _persist_sweep_row(search, row):
     data["rows"] = rows
     with open(path, "w") as f:
         json.dump(data, f, indent=1)
+
+
+def _quant_job(site, precision, *, b=8, t=1024):
+    return {"DTF_QUANT_PARALLEL": site["parallel"],
+            "DTF_QUANT_D_IN": str(site["d_in"]),
+            "DTF_QUANT_D_OUT": str(site["d_out"]),
+            "DTF_QUANT_B": str(b), "DTF_QUANT_T": str(t),
+            "DTF_QUANT_PRECISION": precision}
+
+
+def _precision_key(site, backend):
+    return dict(site="tp_dense", parallel=site["parallel"],
+                d_in=site["d_in"], d_out=site["d_out"], dtype="bfloat16",
+                n_devices=1, backend=backend)
+
+
+def _persist_precision_row(search, row):
+    """Measured precision cells into KERNEL_TUNE_SWEEP.json (same
+    replace-by-identity contract as _persist_sweep_row): `tune seed`
+    after a measuring round reproduces, not reverts, the winners."""
+    path = os.path.join(ROOT, search.SWEEP_ARTIFACT)
+    data = {}
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        data = {}
+    rows = data.get("precision_rows", [])
+
+    def ident(r):
+        return (r.get("parallel"), r.get("d_in"), r.get("d_out"),
+                r.get("b"), r.get("t"), r.get("dtype"), r.get("precision"),
+                r.get("backend"), r.get("n_devices"))
+
+    rows = [r for r in rows if ident(r) != ident(row)] + [row]
+    data["precision_rows"] = rows
+    with open(path, "w") as f:
+        json.dump(data, f, indent=1)
+
+
+def _sweep_precision(sites, precisions, *, backend, measured, budget,
+                     run_jobs, cache, search, summary, b=8, t=1024):
+    """Per site: one bench_quant child per precision candidate; measured
+    rows persist to the sweep artifact and re-seed the golden after
+    EVERY row (a tunnel death mid-sweep keeps whatever was measured).
+    Interpret-mode rows (measured=False) are a wiring check only."""
+    argv = [sys.executable,
+            os.path.join(ROOT, "scripts", "bench_quant.py"), "--child"]
+    parse = lambda line: (json.loads(line[len(QUANT_SENTINEL):])  # noqa: E731
+                          if line.startswith(QUANT_SENTINEL) else None)
+    for site in sites:
+        if measured and _already_banked(cache, "matmul_precision",
+                                        _precision_key(site, backend)):
+            summary["resweep_skipped"] += 1
+            continue
+
+        def bank(row, job, rows, errs):
+            if row is not None and measured:
+                _persist_precision_row(search, row)
+                entries = search.seed_precision_entries(ROOT)
+                if entries:
+                    cache.merge_entries(cache.local_path(), entries,
+                                        generated_by="bench_tune.py")
+                    cache.merge_entries(cache.golden_path(), entries,
+                                        generated_by="bench_tune.py")
+                    summary["winners"].update(
+                        {e.canonical_key(): e.winner for e in entries})
+            summary["precision_rows"] = summary.get(
+                "precision_rows", 0) + (1 if row is not None else 0)
+
+        jobs = [_quant_job(site, p, b=b, t=t) for p in precisions]
+        rows, errs = run_jobs(jobs, argv, parse, budget=budget,
+                              on_result=bank)
+        summary["errors"] += len(errs)
 
 
 def _merge_loss_rows(rows, errors):
@@ -278,6 +375,11 @@ def main() -> int:
             backend=backend, interpret=True, budget=budget,
             run_jobs=run_jobs, cache=cache, search=search,
             summary=summary)
+        _sweep_precision(
+            CPU_PRECISION_SITES, CPU_PRECISION_CANDIDATES,
+            backend=backend, measured=False, budget=budget,
+            run_jobs=run_jobs, cache=cache, search=search,
+            summary=summary, b=1, t=8)
         print(json.dumps(summary))
         return 0
 
@@ -309,6 +411,14 @@ def main() -> int:
     rows, errs = run_jobs(list(LOSS_PATH_JOBS), lm_argv, lm_parse,
                           budget=budget, on_result=on_loss)
     summary["errors"] += len(errs)
+
+    # matmul-precision cells last: each child is a single small matmul
+    # (minutes for the full grid), and the winners they bank replace the
+    # int8 draft policy defaults with timed rows at the same keys.
+    _sweep_precision(PRECISION_SITES, PRECISION_CANDIDATES,
+                     backend=backend, measured=True, budget=budget,
+                     run_jobs=run_jobs, cache=cache, search=search,
+                     summary=summary)
     print(json.dumps(summary))
     return 0
 

@@ -82,6 +82,14 @@ flags.DEFINE_integer("draft_layers", 0, "early-exit draft: reuse the "
                      "first N layers of the SERVED checkpoint as the "
                      "draft model — speculation without a second "
                      "checkpoint (mutually exclusive with --draft_ckpt)")
+flags.DEFINE_enum("draft_precision", "", ["", "auto", "bf16", "int8",
+                                          "fp8"],
+                  "low-precision compute for the DRAFT model's TP "
+                  "projections ('' = bf16, auto = kernel-tune winner, "
+                  "int8/fp8 = explicit pin): the proposal loop runs "
+                  "cheaper while the bf16 verifier keeps emitted tokens "
+                  "byte-identical — only acceptance rate can move "
+                  "(docs/TUNING.md, docs/SERVING.md)")
 flags.DEFINE_integer("kv_page_size", 0, "prefix page width in tokens "
                      "(with --prefix_pages: must divide --max_len)")
 flags.DEFINE_integer("prefix_pages", 0, "prefix KV page-pool size per "
@@ -321,6 +329,15 @@ def main(argv):
         raise app.UsageError(
             f"--spec_k={FLAGS.spec_k} needs a draft model: pass "
             "--draft_ckpt or --draft_layers")
+    if FLAGS.draft_precision:
+        if draft_cfg is None:
+            raise app.UsageError(
+                "--draft_precision quantizes the DRAFT model's matmuls; "
+                "pass --draft_ckpt or --draft_layers")
+        # draft-only: the bf16 verifier re-samples every emitted token,
+        # so this moves acceptance rate, never the token stream.
+        draft_cfg = dataclasses.replace(
+            draft_cfg, matmul_precision=FLAGS.draft_precision)
     if draft_params is not None and sharded and FLAGS.draft_ckpt:
         draft_params = shard_tree(draft_params, mesh, gpt.tp_rules)
     if FLAGS.prefill_replicas:
@@ -541,6 +558,7 @@ def main(argv):
            "draft": ("ckpt" if FLAGS.draft_ckpt
                      else f"layers:{FLAGS.draft_layers}"
                      if FLAGS.draft_layers else ""),
+           "draft_precision": FLAGS.draft_precision,
            "request_statuses": statuses,
            "fault_inject": os.environ.get("DTF_FAULT_INJECT", "")
            if fault_plan is not None else "",

@@ -22,6 +22,12 @@ python scripts/tpu_smoke.py
 # loss-path winners into KERNEL_TUNE.json so every bench below — and
 # the PR 8 MFU fences — measures at tuned defaults (docs/TUNING.md)
 python scripts/bench_tune.py
+# precision A/B (ISSUE 17): bf16/int8/fp8 tp_dense cells + rel_err →
+# BENCH_QUANT.json; rows bank into KERNEL_TUNE_SWEEP.json
+# precision_rows and flip the matmul_precision policy entries to
+# measured on re-seed (bench_tune's precision sweep skips
+# already-banked cells, so running both is cheap)
+python scripts/bench_quant.py
 python scripts/bench_lm.py
 python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --sweep-bert

@@ -50,6 +50,15 @@ flags.DEFINE_boolean("tp_overlap", False, "latency-hiding collective "
                      "sharded einsum into a ppermute ring overlapped with "
                      "per-chunk matmuls (needs --mesh_model>1; "
                      "docs/OVERLAP.md)")
+flags.DEFINE_enum("matmul_precision", "", ["", "auto", "bf16", "int8",
+                                           "fp8"],
+                  "low-precision compute for the Megatron TP projections: "
+                  "'' = bf16 (no tuner), auto = the banked kernel-tune "
+                  "winner per projection site, int8/fp8 = explicit pin "
+                  "(wins over a measured winner with one WARN). Forward "
+                  "only — gradients and master weights stay full "
+                  "precision; with --tp_overlap the ring payload is what "
+                  "quantizes (docs/TUNING.md)")
 flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
                      "mesh_pipe>1 (0 = 4x stages, the bubble-amortizing "
                      "default)")
@@ -148,6 +157,7 @@ def main(argv):
                               attn_window=FLAGS.attn_window,
                               attn_global_every=FLAGS.attn_global_every,
                               tp_overlap=FLAGS.tp_overlap,
+                              matmul_precision=FLAGS.matmul_precision,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
     sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR

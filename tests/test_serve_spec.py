@@ -123,6 +123,24 @@ def test_spec_identity_matrix(params, draft, pages):
         assert sched._spec_accepted > 0
 
 
+def test_spec_identity_quantized_draft(params):
+    """ISSUE 17's serving win: an int8-quantized DRAFT proposes (TpDense
+    routes through quantized_matmul), the bf16 verifier samples every
+    delivered token — streams stay bitwise equal to offline generate().
+    Draft precision is a throughput/acceptance knob, never correctness."""
+    dcfg, dparams = gpt.draft_truncate(CFG, params, 1)
+    dcfg = dataclasses.replace(dcfg, matmul_precision="int8")
+    eng = DecodeEngine(CFG, params, n_slots=4, max_len=MAX_LEN,
+                       prefill_chunk=5, draft_cfg=dcfg,
+                       draft_params=dparams, spec_k=3)
+    sched = Scheduler(eng, None, prefill_chunks_per_tick=2)
+    reqs = _mixed_reqs(4, seed=5)
+    rids = [sched.submit(Request(**r)) for r in reqs]
+    sched.run_until_idle()
+    for r, rid in zip(reqs, rids):
+        assert sched.poll(rid)["tokens"] == _offline(params, r), r
+
+
 @pytest.mark.slow
 def test_spec_eos_and_budget_edges(params, spec_engine):
     """EOS mid-verify-chain truncates delivery exactly where offline
