@@ -129,6 +129,7 @@ def child():
     # model runs AT the v5e HBM-bandwidth roofline — mfu_xla and the
     # bandwidth utilisation say how close to the achievable ceiling we are.
     try:
+        # aot-ok: roofline cost analysis of the bench step
         cost = step.lower(state, data).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]

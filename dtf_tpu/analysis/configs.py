@@ -31,7 +31,7 @@ import optax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from dtf_tpu.core import train as tr
-from dtf_tpu.core.comms import batch_sharding, batch_shardings_for
+from dtf_tpu.core.comms import batch_shardings_for
 from dtf_tpu.core.mesh import MeshConfig, make_mesh
 from dtf_tpu.data.synthetic import SyntheticData
 
@@ -56,6 +56,16 @@ class StepView:
     #: committed shardings (``state-accounting-drift``).  None = each
     #: abstract leaf carries its own ``.sharding`` (the serve views).
     arg_shardings: Any = None
+
+    @classmethod
+    def of(cls, program, state, batch) -> "StepView":
+        """The view of an executor :class:`~dtf_tpu.core.executor.Program`:
+        the builder already registered its declared input layouts on the
+        Program (``arg_shardings``), so config builders stop re-spelling
+        the tuple they just passed to jit — one declaration, consumed by
+        both the compile and the memory fence."""
+        return cls(program, state, batch,
+                   arg_shardings=getattr(program, "arg_shardings", None))
 
 
 @dataclasses.dataclass(frozen=True)
@@ -119,8 +129,7 @@ def _mnist_step(mesh):
     state, shardings = tr.abstract_train_state(
         mnist.make_init(model), tx, _rng(), mesh)
     step = tr.make_train_step(mnist.make_loss(model), tx, mesh, shardings)
-    return StepView(step, state, _abstract_batch("mnist", 32),
-                    arg_shardings=(shardings, batch_sharding(mesh)))
+    return StepView.of(step, state, _abstract_batch("mnist", 32))
 
 
 def _resnet_spec(variant):
@@ -149,8 +158,7 @@ def _resnet_step(variant, batch):
             resnet.make_init(model, shape), tx, _rng(), mesh)
         step = tr.make_train_step(
             resnet.make_loss(model, weight_decay=1e-4), tx, mesh, shardings)
-        return StepView(step, state, _abstract_batch(variant, batch),
-                        arg_shardings=(shardings, batch_sharding(mesh)))
+        return StepView.of(step, state, _abstract_batch(variant, batch))
 
     return build
 
@@ -177,8 +185,7 @@ def _bert_step(mesh):
     step = tr.make_train_step(
         bert.make_loss(model), tx, mesh, shardings, grad_accum=2,
         batch_shardings=batch_sh)
-    return StepView(step, state, batch,
-                    arg_shardings=(shardings, batch_sh))
+    return StepView.of(step, state, batch)
 
 
 def _bert_accum_step(grad_shard):
@@ -204,8 +211,7 @@ def _bert_accum_step(grad_shard):
         step = tr.make_train_step(
             bert.make_loss(model), tx, mesh, shardings, grad_accum=2,
             grad_shard=grad_shard, batch_shardings=batch_sh)
-        return StepView(step, state, batch,
-                        arg_shardings=(shardings, batch_sh))
+        return StepView.of(step, state, batch)
 
     return build
 
@@ -228,8 +234,7 @@ def _widedeep_step(mesh):
         param_rules=widedeep.rules)
     step = tr.make_train_step(widedeep.make_loss(model), tx, mesh,
                               shardings)
-    return StepView(step, state, _abstract_batch("widedeep", 64),
-                    arg_shardings=(shardings, batch_sharding(mesh)))
+    return StepView.of(step, state, _abstract_batch("widedeep", 64))
 
 
 def _gpt_cfg(tiny: bool, **kw):
@@ -278,10 +283,7 @@ def _gpt_step(**cfg_kw):
                 batch, mesh, P("data", "seq"))
         step = tr.make_train_step(gpt.make_loss(model), tx, mesh,
                                   shardings, **kw)
-        return StepView(step, state, batch,
-                        arg_shardings=(shardings,
-                                       kw.get("batch_shardings",
-                                              batch_sharding(mesh))))
+        return StepView.of(step, state, batch)
 
     return build
 
@@ -321,8 +323,7 @@ def _gpt_eval_step(mesh):
     batch_sh = batch_shardings_for(batch, mesh, P("data", "seq"))
     step = tr.make_eval_step(gpt.make_eval(model), mesh, shardings,
                              batch_shardings=batch_sh)
-    return StepView(step, state, batch,
-                    arg_shardings=(shardings, batch_sh))
+    return StepView.of(step, state, batch)
 
 
 def _gpt_prefill_step(mesh):
@@ -430,16 +431,16 @@ def _gpt_pipe_step(schedule):
         state, shardings = tr.abstract_train_state(
             init_fn, tx, _rng(), mesh, param_rules=gpt_pipe.pipe_rules())
         batch = _abstract_batch("gpt", 16, seq_len=32, vocab_size=128)
-        if schedule == "1f1b":
-            grads_fn = gpt_pipe.make_pipe_grads_1f1b(
-                cfg, mesh, n_microbatches=4)
+        if schedule in ("1f1b", "zb"):
+            maker = {"1f1b": gpt_pipe.make_pipe_grads_1f1b,
+                     "zb": gpt_pipe.make_pipe_grads_zb}[schedule]
+            grads_fn = maker(cfg, mesh, n_microbatches=4)
             step = tr.make_train_step_from_grads(grads_fn, tx, mesh,
                                                  shardings)
         else:
             loss_fn = gpt_pipe.make_pipe_loss(cfg, mesh, n_microbatches=4)
             step = tr.make_train_step(loss_fn, tx, mesh, shardings)
-        return StepView(step, state, batch,
-                        arg_shardings=(shardings, batch_sharding(mesh)))
+        return StepView.of(step, state, batch)
 
     return build
 
@@ -464,9 +465,9 @@ def _gpt_pipe_tp_step(mesh):
         param_rules=gpt_pipe_tp.pipe_tp_rules())
     loss_fn = gpt_pipe_tp.make_pipe_tp_loss(cfg, mesh, n_microbatches=4)
     step = tr.make_train_step(loss_fn, tx, mesh, shardings)
-    return StepView(step, state,
-                    _abstract_batch("gpt", 8, seq_len=32, vocab_size=128),
-                    arg_shardings=(shardings, batch_sharding(mesh)))
+    return StepView.of(
+        step, state,
+        _abstract_batch("gpt", 8, seq_len=32, vocab_size=128))
 
 
 #: the registry: five BASELINE workloads + the GPT flagship + pipelined
@@ -566,6 +567,12 @@ REGISTRY: tuple[AnalysisConfig, ...] = (
                    replicated_ok=(r"^embed/", r"^head/")),
     AnalysisConfig("gpt_pipe_1f1b", MeshConfig(data=4, pipe=2),
                    _gpt_pipe_spec, _gpt_pipe_step("1f1b"),
+                   replicated_ok=(r"^embed/", r"^head/")),
+    AnalysisConfig("gpt_pipe_zb", MeshConfig(data=4, pipe=2),
+                   _gpt_pipe_spec, _gpt_pipe_step("zb"),
+                   # same layout contract as gpt_pipe_1f1b: ZB only
+                   # re-orders the backward (B now, W deferred into the
+                   # bubble) — embed/head stay ZeRO-1 over data.
                    replicated_ok=(r"^embed/", r"^head/")),
     AnalysisConfig("gpt_pipe_tp", MeshConfig(data=2, pipe=2, model=2),
                    _gpt_pipe_tp_spec, _gpt_pipe_tp_step,

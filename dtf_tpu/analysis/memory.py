@@ -712,6 +712,9 @@ def _fit_train(config, hbm_bytes: int, *, opt: Optional[str],
     b0 = jax.tree.leaves(view.batch)[0].shape[0]
     temps = {}
     for b in (b0, 2 * b0):
+        # the memory pass probes the SAME registered program at a
+        # scaled batch — a throwaway measurement lowering, not a
+        # aot-ok: new program birth
         compiled = view.step.lower(view.state,
                                    _scale_batch(view.batch, b)).compile()
         temps[b] = int(compiled.memory_analysis().temp_size_in_bytes)

@@ -73,8 +73,10 @@ def compile_program(config: cfgs.AnalysisConfig, view=None):
     shardings.
     """
     view = view or config.step_view(config.mesh())
+    # the analyzer compiles registered step views for fencing — this
+    # aot-ok: IS the consumer the executor registers abstracts for
     lowered = view.step.lower(view.state, view.batch)
-    return view, lowered, lowered.compile()
+    return view, lowered, lowered.compile()  # aot-ok: compile leg
 
 
 def compile_budget(config: cfgs.AnalysisConfig, view=None) -> dict:

@@ -208,6 +208,17 @@ def lint_file(path: str) -> list[str]:
             "dtf_tpu" in dirs or not dirs or dirs[-1] == "dtf_tpu"):
         problems += _hotpath_readbacks(tree, path, noqa, src)
 
+    # ---- raw AOT lower/compile outside the executor (ISSUE 18) ----
+    # core/executor.py is the one sanctioned home of the
+    # jit→lower→compile idiom; tune/ sweeps compile candidate programs
+    # by design, and tests exercise raw AOT surfaces directly.
+    blessed_aot_module = (
+        (base == "executor.py" and (not dirs or dirs[-1] == "core"))
+        or (("tune" in dirs) if anchored
+            else (bool(dirs) and dirs[-1] == "tune")))
+    if not (blessed_aot_module or in_tests):
+        problems += _raw_aot_compiles(tree, path, noqa, src)
+
     # ---- backend imports fenced out of telemetry/tune/fault/stream ----
     # telemetry: reports parse traces on chipless machines. tune: the
     # bench_tune parent imports the package BEFORE probing the backend
@@ -454,6 +465,45 @@ def _raw_ppermute_perms(tree, path: str, noqa: set) -> list:
             f"build it with core.comms.ring_perm/shift_perm (the named "
             f"helpers the collective soundness pass introspects); a "
             f"hand-typed pair list dodges the ring fence")
+    return problems
+
+
+def _raw_aot_compiles(tree, path: str, noqa: set, src: str) -> list:
+    """``.lower(args)`` / ``.compile(`` attribute calls outside
+    ``core/executor.py`` (+ tune/ + tests) — the AOT idiom must route
+    through :func:`dtf_tpu.core.executor.program`, the one place that
+    owns the recompile fence, sharding pins, the donation gate and the
+    analysis step-view registration (ISSUE 18). A deliberate raw site
+    carries ``# aot-ok: <why>`` (covers its line and the next, so the
+    idiomatic two-line ``.lower(...)\\n.compile()`` needs one pin).
+
+    Skipped on purpose: no-argument ``.lower()`` (``str.lower`` — the
+    bare-operand Program.lower() spelling is executor-internal) and
+    ``re.compile(``."""
+    ok: set[int] = set()
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# aot-ok" in line:
+            ok.update((i, i + 1))
+    problems = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("lower", "compile")
+                and node.lineno not in noqa
+                and node.lineno not in ok):
+            continue
+        if (node.func.attr == "lower" and not node.args
+                and not node.keywords):
+            continue                      # str.lower()
+        fn_base = node.func.value
+        if (node.func.attr == "compile"
+                and isinstance(fn_base, ast.Name) and fn_base.id == "re"):
+            continue                      # re.compile()
+        problems.append(
+            f"{path}:{node.lineno}: raw .{node.func.attr}( AOT idiom — "
+            f"route through dtf_tpu.core.executor.program (the fence / "
+            f"pins / donation / step-view choke point; docs/ANALYSIS.md), "
+            f"or mark a deliberate site with '# aot-ok: <why>'")
     return problems
 
 

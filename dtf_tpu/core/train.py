@@ -32,6 +32,7 @@ from flax import struct
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dtf_tpu import _jax_compat as _compat
+from dtf_tpu.core import executor
 from dtf_tpu.core import sharding as shd
 from dtf_tpu.core.comms import (batch_sharding, global_norm,
                                 grad_reduce_scatter, shard_grads,
@@ -406,13 +407,14 @@ def make_train_step(
         # fence pins Trainer.trace_counts["train_step"] at 1 in steady
         # state, the DecodeEngine.trace_counts contract for training.
         step_fn = telemetry.count_traces("train_step", step_fn)
-    return jax.jit(
-        step_fn,
-        in_shardings=(shardings, batch_sh),
-        out_shardings=(shardings, NamedSharding(mesh, P())),
-        # donation is version-gated through donation_enabled() — the
-        # analyzer's memory pass asserts the gate (see its docstring).
-        donate_argnums=(0,) if donation_enabled(donate) else (),
+    # donation is version-gated through donation_enabled() — the
+    # analyzer's memory pass asserts the gate; the executor routes
+    # donate= through it (executor.donation_argnums).
+    return executor.program(
+        "train_step", step_fn, donate=donate,
+        jit_kw=dict(in_shardings=(shardings, batch_sh),
+                    out_shardings=(shardings, NamedSharding(mesh, P()))),
+        arg_shardings=(shardings, batch_sh),
     )
 
 
@@ -460,13 +462,12 @@ def make_train_step_from_grads(
         # same retrace fence as make_train_step (one program name: the
         # trainer runs exactly one step program either way)
         step_fn = telemetry.count_traces("train_step", step_fn)
-    return jax.jit(
-        step_fn,
-        in_shardings=(shardings, batch_sh),
-        out_shardings=(shardings, NamedSharding(mesh, P())),
-        # donation is version-gated through donation_enabled() — the
-        # analyzer's memory pass asserts the gate (see its docstring).
-        donate_argnums=(0,) if donation_enabled(donate) else (),
+    # same executor routing (and donation gate) as make_train_step.
+    return executor.program(
+        "train_step", step_fn, donate=donate,
+        jit_kw=dict(in_shardings=(shardings, batch_sh),
+                    out_shardings=(shardings, NamedSharding(mesh, P()))),
+        arg_shardings=(shardings, batch_sh),
     )
 
 
@@ -485,13 +486,14 @@ def make_eval_step(eval_fn: Callable, mesh: Mesh, shardings: TrainState, *,
 
     if telemetry is not None:
         step_fn = telemetry.count_traces("eval_step", step_fn)
-    return jax.jit(
-        step_fn,
-        # `is not None`, not truthiness: a falsy-but-valid shardings pytree
-        # must not silently degrade to the default placement (same rule as
-        # make_train_step's parameter of this name).
-        in_shardings=(shardings,
-                      batch_shardings if batch_shardings is not None
-                      else batch_sharding(mesh)),
-        out_shardings=NamedSharding(mesh, P()),
+    # `is not None`, not truthiness: a falsy-but-valid shardings pytree
+    # must not silently degrade to the default placement (same rule as
+    # make_train_step's parameter of this name).
+    batch_sh = (batch_shardings if batch_shardings is not None
+                else batch_sharding(mesh))
+    return executor.program(
+        "eval_step", step_fn,
+        jit_kw=dict(in_shardings=(shardings, batch_sh),
+                    out_shardings=NamedSharding(mesh, P())),
+        arg_shardings=(shardings, batch_sh),
     )

@@ -249,12 +249,36 @@ def make_pipe_grads_1f1b(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
     with loss-sum 0 instead of nothing) — unreachable in CLM training,
     where every position carries a label.
     """
+    return _make_pipe_grads(cfg, mesh, n_microbatches=n_microbatches,
+                            axis_name=axis_name, schedule="1f1b")
+
+
+def make_pipe_grads_zb(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
+                       axis_name: str = "pipe"):
+    """Zero-bubble variant of :func:`make_pipe_grads_1f1b`.
+
+    Identical contract and param layout, but the blocks run through
+    :func:`dtf_tpu.parallel.pipeline.pipeline_zb_grads` — each stage's
+    backward split into B (activation grad, critical path) and W (weight
+    grad, deferred into the 1F1B drain bubble). Grads are bitwise equal to
+    the 1F1B schedule on integer data and allclose on real data; the
+    schedule-level win is priced by
+    :func:`dtf_tpu.parallel.pipeline.schedule_bubble_model`.
+    """
+    return _make_pipe_grads(cfg, mesh, n_microbatches=n_microbatches,
+                            axis_name=axis_name, schedule="zb")
+
+
+def _make_pipe_grads(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
+                     axis_name: str, schedule: str):
     n_stages = mesh.shape.get(axis_name, 1)
     seq_shards = mesh.shape.get("seq", 1)
     per_row = validate_pipe_cfg(cfg, n_stages, 1, seq_shards)
     sp = seq_shards > 1
     stage = GPTStage(cfg, per_row, manual_seq=sp)
     batch_spec = P("data", "seq") if sp else P("data")
+    schedule_fn = {"1f1b": pp.pipeline_1f1b_grads,
+                   "zb": pp.pipeline_zb_grads}[schedule]
 
     def first_fn(p_embed, mb):
         return GPTEmbed(cfg).apply({"params": p_embed}, mb["input_ids"])
@@ -271,7 +295,7 @@ def make_pipe_grads_1f1b(cfg: GPTConfig, mesh: Mesh, *, n_microbatches: int,
         # shards reproduces the full-batch token mean exactly.
         return loss * n, n
 
-    run = pp.pipeline_1f1b_grads(
+    run = schedule_fn(
         first_fn, stage_fn, last_fn, n_microbatches, mesh,
         axis_name=axis_name, batch_spec=batch_spec, check_vma=False)
 

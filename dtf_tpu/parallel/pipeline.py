@@ -407,6 +407,300 @@ def pipeline_1f1b_grads(
     return f
 
 
+def pipeline_zb_grads(
+    first_fn: Callable[[PyTree, PyTree], jax.Array],
+    stage_fn: Callable[[PyTree, jax.Array], jax.Array],
+    last_fn: Callable[[PyTree, jax.Array, PyTree], tuple],
+    n_microbatches: int,
+    mesh: Mesh,
+    *,
+    axis_name: str = AXIS_PIPE,
+    batch_spec: P = P("data"),
+    check_vma: bool = False,
+):
+    """Zero-bubble 1F1B: W/B-split backward, W scheduled into the bubble.
+
+    Same contract, signature and schedule skeleton as
+    :func:`pipeline_1f1b_grads`, but each microbatch's backward is split
+    (the ZB-H1 move, arxiv 2412.14374):
+
+    - ``B(i, m)`` — activation-grad only (``vjp`` w.r.t. the stage INPUT),
+      on the critical path: the cotangent must reach stage ``i-1`` next
+      round. Runs where 1F1B ran its fused backward, ``r = 2S-2-i + m``,
+      and pushes ``dy`` into a depth-``S`` ring (the stage input is already
+      in the 1F1B remat stash — slot ``m % C`` is not overwritten until
+      round ``i + m + 2S-1``, after every consumer).
+    - ``W(i, m)`` — weight-grad (``vjp`` w.r.t. the stage PARAMS with the
+      stashed ``dy``), deferrable: nothing downstream consumes it until the
+      end-of-step psum. It runs at ``r = 2S-2 + m`` — device ``i`` thereby
+      defers exactly ``i`` W passes into its ``i`` post-drain idle rounds,
+      so the last W lands on the last round and total rounds stay
+      ``M + 2S-2``. The stash bound (``2S-1`` slots + the ``S``-deep dy
+      ring) and the 1F1B <=2S-2-in-flight property are preserved.
+
+    In the lockstep scan both sub-slots still execute every round (masked
+    when idle — the collective-uniformity invariant below), so the CPU-sim
+    wall clock does not shrink; the win is on the MPMD executor the
+    schedule targets, where a device's W fills wall-clock holes between
+    dependency-gated F/B ops (see :func:`schedule_bubble_model` for the
+    step-count accounting, and ``scripts/bench_pipe_mem.py`` for the
+    banked rows). On this remat-style path W re-runs the stage forward
+    from the stashed input (same recompute class as 1F1B's fused
+    backward, paid once more).
+
+    Gradient accumulation order is pinned to 1F1B's: W contributions are
+    popped FIFO (increasing ``m``), and idle-round contributions are exact
+    zeros (vjp is linear in the cotangent), so on integer-valued data the
+    returned grads are BITWISE equal to :func:`pipeline_1f1b_grads` —
+    asserted in tests/test_pipeline.py.
+    """
+    n_stages = mesh.shape.get(axis_name, 1)
+    if n_stages == 1:
+        # degenerate pipe axis: no bubble to fill, no schedule — the 1F1B
+        # per-microbatch value_and_grad scan is already fused and optimal.
+        return pipeline_1f1b_grads(
+            first_fn, stage_fn, last_fn, n_microbatches, mesh,
+            axis_name=axis_name, batch_spec=batch_spec, check_vma=check_vma)
+    S, M = n_stages, n_microbatches
+    reduce_axes = _axes_of(batch_spec)
+    all_axes = (axis_name,) + reduce_axes
+
+    def z32(p):
+        return jax.tree.map(lambda t: jnp.zeros(t.shape, jnp.float32), p)
+
+    def add32(a, d):
+        return jax.tree.map(lambda t, u: t + u.astype(jnp.float32), a, d)
+
+    def f(p_first, p_stack, p_last, batch):
+        b0 = jax.tree.leaves(batch)[0].shape[0]
+        if b0 % M:
+            raise ValueError(
+                f"batch {b0} not divisible by n_microbatches={M}")
+        n_stacked = jax.tree.leaves(p_stack)[0].shape[0]
+        if n_stacked != S:
+            raise ValueError(
+                f"stage stack has {n_stacked} stages but the '{axis_name}' "
+                f"mesh axis has {S} shards; they must match")
+        micro = jax.tree.map(
+            lambda x: x.reshape((M, x.shape[0] // M) + x.shape[1:]), batch)
+
+        C = 2 * S - 1          # stash slots; in-flight <= 2S-2 (1F1B bound)
+        R = M + 2 * S - 2      # total rounds — unchanged by the W split
+
+        def body(p_first, p_stack, p_last, mb):
+            p_stage = jax.tree.map(lambda t: t[0], p_stack)
+            idx = jax.lax.axis_index(axis_name)
+            down = shift_perm(S)
+            up = shift_perm(S, shift=-1)
+            mb0 = jax.tree.map(lambda t: t[0], mb)
+            x_sd = jax.eval_shape(first_fn, p_first, mb0)
+            act0 = jnp.zeros(x_sd.shape, x_sd.dtype)
+            stash0 = jnp.zeros((C,) + x_sd.shape, x_sd.dtype)
+            dyq0 = jnp.zeros((S,) + x_sd.shape, x_sd.dtype)
+
+            def pick(m):
+                return jax.tree.map(
+                    lambda t: jax.lax.dynamic_index_in_dim(
+                        t, m, 0, keepdims=False), mb)
+
+            def round_fn(carry, r):
+                act, cot, stash, dyq, gf, gs, gl, ls, ws = carry
+                m_f = r - idx
+                f_on = (m_f >= 0) & (m_f < M)
+                m_fc = jnp.clip(m_f, 0, M - 1)
+                m_b = r - (2 * S - 2 - idx)
+                b_on = (m_b >= 0) & (m_b < M)
+                m_bc = jnp.clip(m_b, 0, M - 1)
+                m_w = r - (2 * S - 2)
+                w_on = (m_w >= 0) & (m_w < M)
+                m_wc = jnp.clip(m_w, 0, M - 1)
+
+                # Collective-uniformity invariant: exactly as in
+                # pipeline_1f1b_grads, the stage forward, its B (input)
+                # vjp and its W (param) vjp all run UNCONDITIONALLY every
+                # round — masked inputs / masked stash writes / zeroed
+                # cotangents — because stage_fn may contain collectives
+                # over other mesh axes and those must never sit under a
+                # pipe-varying lax.cond. first_fn/last_fn stay under cond
+                # (collective-free by contract).
+
+                # ---- forward sub-slot (identical to 1F1B) ----
+                mb_f = pick(m_fc)
+                x_in = jax.lax.cond(
+                    idx == 0,
+                    lambda: first_fn(p_first, mb_f).astype(act.dtype),
+                    lambda: act)
+                y = stage_fn(p_stage, x_in)
+                cur = jax.lax.dynamic_index_in_dim(stash, m_fc % C, 0,
+                                                   keepdims=False)
+                stash = jax.lax.dynamic_update_index_in_dim(
+                    stash, jnp.where(f_on, x_in, cur), m_fc % C, 0)
+                act = jax.lax.ppermute(
+                    jnp.where(f_on, y, jnp.zeros_like(y)), axis_name, down)
+
+                # ---- B sub-slot: activation grad only ----
+                mb_b = pick(m_bc)
+                x_b = jax.lax.dynamic_index_in_dim(stash, m_bc % C, 0,
+                                                   keepdims=False)
+                y2, xvjp = jax.vjp(lambda xx: stage_fn(p_stage, xx), x_b)
+
+                def last_dy(_):
+                    def lf(pl, yy):
+                        return last_fn(pl, yy, mb_b)
+                    l, lvjp, w = jax.vjp(lf, p_last, y2, has_aux=True)
+                    seed = jnp.where(b_on, jnp.ones_like(l),
+                                     jnp.zeros_like(l))
+                    dpl, dy = lvjp(seed)
+                    on = b_on.astype(jnp.float32)
+                    return (dy.astype(y2.dtype), add32(gl, dpl),
+                            ls + on * l.astype(jnp.float32),
+                            ws + on * w.astype(jnp.float32))
+
+                dy, gl, ls, ws = jax.lax.cond(
+                    idx == S - 1, last_dy,
+                    lambda _: (jnp.where(b_on, cot, jnp.zeros_like(cot)),
+                               gl, ls, ws),
+                    None)
+                (dx,) = xvjp(dy)
+                # push dy for the deferred W pass; slot m % S is not
+                # re-written until B(m+S) at round 2S-2-i+m+S, strictly
+                # after W(m) pops it at round 2S-2+m (i <= S-1).
+                qcur = jax.lax.dynamic_index_in_dim(dyq, m_bc % S, 0,
+                                                    keepdims=False)
+                dyq = jax.lax.dynamic_update_index_in_dim(
+                    dyq, jnp.where(b_on, dy.astype(act.dtype), qcur),
+                    m_bc % S, 0)
+
+                def first_g(_):
+                    _, fvjp = jax.vjp(lambda pf: first_fn(pf, mb_b),
+                                      p_first)
+                    (dpf,) = fvjp(dx.astype(x_sd.dtype))
+                    return add32(gf, dpf)
+
+                gf = jax.lax.cond(idx == 0, first_g, lambda _: gf, None)
+                cot = jax.lax.ppermute(dx.astype(act.dtype), axis_name, up)
+
+                # ---- W sub-slot: deferred weight grad, FIFO pop ----
+                # stash slot m % C still holds the stage input (see
+                # docstring); the forward is recomputed from it, exactly
+                # the remat 1F1B's fused backward did.
+                x_w = jax.lax.dynamic_index_in_dim(stash, m_wc % C, 0,
+                                                   keepdims=False)
+                dy_w = jax.lax.dynamic_index_in_dim(dyq, m_wc % S, 0,
+                                                    keepdims=False)
+                _, pvjp = jax.vjp(lambda q: stage_fn(q, x_w), p_stage)
+                (dps,) = pvjp(jnp.where(w_on, dy_w, jnp.zeros_like(dy_w)))
+                gs = add32(gs, dps)
+                return (act, cot, stash, dyq, gf, gs, gl, ls, ws), None
+
+            init = (act0, jnp.zeros_like(act0), stash0, dyq0,
+                    z32(p_first), z32(p_stage), z32(p_last),
+                    jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            (_, _, _, _, gf, gs, gl, ls, ws), _ = jax.lax.scan(
+                round_fn, init, jnp.arange(R))
+
+            if reduce_axes:
+                gs = jax.lax.psum(gs, reduce_axes)
+            gf = jax.lax.psum(gf, all_axes)
+            gl = jax.lax.psum(gl, all_axes)
+            ls = jax.lax.psum(ls, all_axes)
+            ws = jax.lax.psum(ws, all_axes)
+            gs = jax.tree.map(lambda t: t[None], gs)
+            return ls, ws, gf, gs, gl
+
+        micro_spec = P(None, *batch_spec)
+        ls, ws, gf, gs, gl = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(axis_name), P(),
+                      jax.tree.map(lambda _: micro_spec, batch)),
+            out_specs=(P(), P(), P(), P(axis_name), P()),
+            check_vma=check_vma,
+        )(p_first, p_stack, p_last, micro)
+        return ls, ws, (gf, gs, gl)
+
+    return f
+
+
+def schedule_bubble_model(n_stages: int, n_microbatches: int,
+                          schedule: str = "1f1b", *,
+                          t_f: float = 1.0, t_b: float = 1.0,
+                          t_w: float = 1.0) -> dict:
+    """Step-count bubble model for the fused-1F1B vs zero-bubble schedules.
+
+    Simulates the MPMD executor the schedules target: each device runs its
+    op sequence in schedule order, an op starts when the device is free AND
+    its cross-device dependency has finished (``F(i,m)`` after ``F(i-1,m)``;
+    ``B(i,m)`` after ``B(i+1,m)``, with the last stage's after its own
+    ``F``; ``W(i,m)`` after its own ``B(i,m)``). 1F1B's backward is one
+    fused op of cost ``t_b + t_w``; ZB splits it and defers W off the
+    critical path, which shrinks the fill/drain bubble from
+    ``(S-1)(t_f+t_b+t_w)`` toward ``(S-1)(t_f+t_b-t_w)`` (ZB-H1). The
+    lockstep ``lax.scan`` realisation cannot show this (every round waits
+    for the slowest sub-slot fleet-wide); this model is the schedule's
+    honest accounting and is asserted in tests + banked into PIPE_MEM.json.
+
+    Returns ``{"makespan", "busy", "idle_frac", "bubble"}`` — ``busy`` is
+    total work per device-timeline (the same for both schedules), so
+    ``idle_frac = 1 - busy / (S * makespan)`` is directly comparable.
+    """
+    if schedule not in ("1f1b", "zb"):
+        raise ValueError(f"unknown schedule {schedule!r}")
+    S, M = n_stages, n_microbatches
+    cost = {"F": t_f, "B": t_b, "W": t_w, "BW": t_b + t_w}
+
+    def device_ops(i):
+        evs = []
+        for m in range(M):
+            evs.append((i + m, 0, "F", m))
+            if schedule == "1f1b":
+                evs.append((2 * S - 2 - i + m, 1, "BW", m))
+            else:
+                evs.append((2 * S - 2 - i + m, 1, "B", m))
+                evs.append((2 * S - 2 + m, 2, "W", m))
+        evs.sort()
+        return [(kind, m) for _, _, kind, m in evs]
+
+    bk = "BW" if schedule == "1f1b" else "B"
+
+    def dep(kind, i, m):
+        if kind == "F":
+            return ("F", i - 1, m) if i else None
+        if kind == "W":
+            return ("B", i, m)
+        return ("F", i, m) if i == S - 1 else (bk, i + 1, m)
+
+    ops = {i: device_ops(i) for i in range(S)}
+    ptr = [0] * S
+    avail = [0.0] * S
+    done: dict[tuple, float] = {}
+    while any(ptr[i] < len(ops[i]) for i in range(S)):
+        progress = False
+        for i in range(S):
+            while ptr[i] < len(ops[i]):
+                kind, m = ops[i][ptr[i]]
+                d = dep(kind, i, m)
+                if d is not None and d not in done:
+                    break
+                t0 = max(avail[i], done.get(d, 0.0))
+                done[(kind, i, m)] = t0 + cost[kind]
+                avail[i] = t0 + cost[kind]
+                ptr[i] += 1
+                progress = True
+        if not progress:  # pragma: no cover - schedule bug guard
+            raise RuntimeError("deadlock in schedule model")
+    makespan = max(done.values())
+    busy = M * (t_f + t_b + t_w)
+    return {
+        "schedule": schedule,
+        "n_stages": S,
+        "n_microbatches": M,
+        "makespan": makespan,
+        "busy": busy,
+        "idle_frac": 1.0 - busy / makespan,
+        "bubble": makespan - busy,
+    }
+
+
 def interleaved_stage_order(n_devices: int, v_per_device: int) -> list[int]:
     """Stack-row order for the interleaved schedule.
 

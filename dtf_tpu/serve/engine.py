@@ -92,6 +92,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dtf_tpu.core import executor
 from dtf_tpu.models import gpt
 
 log = logging.getLogger("dtf_tpu")
@@ -595,10 +596,6 @@ class DecodeEngine:
                 draft_params = jax.tree.map(
                     lambda x: jax.device_put(x, dev), draft_params)
         self._params = params
-        self._decode_model = gpt.GPT(
-            dataclasses.replace(base, slot_decode=True), mesh)
-        self._prefill_model = gpt.GPT(
-            dataclasses.replace(base, chunked_prefill=True), mesh)
 
         struct = _state_struct(dataclasses.replace(base, slot_decode=True),
                                n_slots, mesh)
@@ -619,13 +616,6 @@ class DecodeEngine:
         #: decode/verify (ONE program: the verify step IS spec decode),
         #: draft_prefill, draft — and the fence pins all four.
         self.trace_counts = {"prefill": 0, "decode": 0}
-        prefill_fn = _build_prefill_fn(self._prefill_model)
-
-        def counted(name, fn):
-            def wrapped(*args):
-                self.trace_counts[name] += 1
-                return fn(*args)
-            return wrapped
 
         def abs_of(tree):
             return jax.tree.map(
@@ -636,42 +626,7 @@ class DecodeEngine:
 
         abs_params = abs_of(params)
         abs_state = abs_of(self._state)
-        s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
-        s_f32 = jax.ShapeDtypeStruct((), jnp.float32)
-        s_bool = jax.ShapeDtypeStruct((), jnp.bool_)
-        chunk_abs = jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32)
-        key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
-        jit_kw, verify_kw = {}, {}
-        rep = None
-        if mesh is not None:
-            # pin the OUTPUT state to the input layout: GSPMD would
-            # otherwise pick its own output shardings, and the next call
-            # of the AOT executable would reject the resharded state
-            rep = NamedSharding(mesh, P())
-            state_sh = jax.tree.map(lambda s: s.sharding, abs_state)
-            jit_kw["out_shardings"] = (state_sh,
-                                       {"token": rep, "done": rep})
-            verify_kw["out_shardings"] = (state_sh,
-                                          {"tokens": rep, "done": rep,
-                                           "n_emit": rep})
-        if self.spec_k:
-            verify_fn = _build_verify_fn(self._decode_model, self.spec_k)
-            props_abs = jax.ShapeDtypeStruct((n_slots, self.spec_k),
-                                             jnp.int32, sharding=rep)
-            self._decode_c = jax.jit(counted("decode", verify_fn),
-                                     **verify_kw).lower(
-                abs_params, abs_state, props_abs).compile()
-        else:
-            decode_fn = _build_decode_fn(self._decode_model)
-            self._decode_c = jax.jit(counted("decode", decode_fn),
-                                     **jit_kw).lower(
-                abs_params, abs_state).compile()
-        self._prefill_c = jax.jit(counted("prefill", prefill_fn),
-                                  **jit_kw).lower(
-            abs_params, abs_state, s_i32, s_i32, chunk_abs, s_i32,
-            s_bool, s_bool, s_f32, s_i32, s_f32, s_i32, s_i32,
-            key_abs).compile()
-
+        abs_trees = {"params": abs_params, "state": abs_state}
         if self.spec_k:
             self.trace_counts.update({"draft_prefill": 0, "draft": 0})
             dbase = dataclasses.replace(
@@ -679,37 +634,30 @@ class DecodeEngine:
                 chunked_prefill=False)
             self.draft_cfg = dbase
             self._draft_params = draft_params
-            self._draft_decode_model = gpt.GPT(
-                dataclasses.replace(dbase, slot_decode=True), mesh)
-            self._draft_prefill_model = gpt.GPT(
-                dataclasses.replace(dbase, chunked_prefill=True), mesh)
             dstruct = _state_struct(
                 dataclasses.replace(dbase, slot_decode=True), n_slots, mesh)
             self._draft_state = _zeros_like_struct(dstruct)
-            abs_dparams = abs_of(draft_params)
-            abs_dstate = abs_of(self._draft_state)
-            dp_kw, da_kw = {}, {}
-            if mesh is not None:
-                dstate_sh = jax.tree.map(lambda s: s.sharding, abs_dstate)
-                dp_kw["out_shardings"] = (dstate_sh,
-                                          {"token": rep, "done": rep})
-                da_kw["out_shardings"] = (dstate_sh, rep)
-            self._draft_prefill_c = jax.jit(
-                counted("draft_prefill",
-                        _build_prefill_fn(self._draft_prefill_model)),
-                **dp_kw).lower(
-                abs_dparams, abs_dstate, s_i32, s_i32, chunk_abs, s_i32,
-                s_bool, s_bool, s_f32, s_i32, s_f32, s_i32, s_i32,
-                key_abs).compile()
-            self._draft_c = jax.jit(
-                counted("draft",
-                        _build_draft_fn(self._draft_decode_model,
-                                        self.spec_k)),
-                **da_kw).lower(
-                abs_dparams, abs_dstate,
-                jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=rep),
-                jax.ShapeDtypeStruct((n_slots,), jnp.int32,
-                                     sharding=rep)).compile()
+            abs_trees["draft_params"] = abs_of(draft_params)
+            abs_trees["draft_state"] = abs_of(self._draft_state)
+        #: the serve program table: every program born fenced through
+        #: dtf_tpu/core/executor.py — the SAME construction the analysis
+        #: step views enumerate, with this engine's abstract trees (real
+        #: array shardings: restored checkpoints keep their layouts).
+        self.programs, models = program_table(
+            base, n_slots=n_slots, max_len=max_len, mesh=mesh,
+            prefill_chunk=prefill_chunk, spec_k=self.spec_k,
+            draft_cfg=self.draft_cfg, counts=self.trace_counts,
+            abs_trees=abs_trees)
+        self._decode_model = models["decode"]
+        self._prefill_model = models["prefill"]
+        self._decode_c = self.programs["decode"].aot()
+        self._prefill_c = self.programs["prefill"].aot()
+
+        if self.spec_k:
+            self._draft_decode_model = models["draft"]
+            self._draft_prefill_model = models["draft_prefill"]
+            self._draft_prefill_c = self.programs["draft_prefill"].aot()
+            self._draft_c = self.programs["draft"].aot()
             #: host mirrors of the verifier's per-slot position and
             #: pending token (fed to draft_all as sync operands): updated
             #: from values decode() reads back ANYWAY (tokens/n_emit), so
@@ -765,29 +713,13 @@ class DecodeEngine:
                                           save_after=page_save_after))
                 self._owns_pages = True
             self.page_trace_counts = {"save": 0, "load": 0}
-
-            def pcounted(name, fn):
-                def wrapped(*args):
-                    self.page_trace_counts[name] += 1
-                    return fn(*args)
-                return wrapped
-
-            save_kw, load_kw = {}, {}
-            if mesh is not None:
-                save_kw["out_shardings"] = jax.tree.map(
-                    lambda s: s.sharding, pool_abs)
-                load_kw["out_shardings"] = jax.tree.map(
-                    lambda s: s.sharding, abs_state)
-            ids_abs = jax.ShapeDtypeStruct((max_len // kv_page_size,),
-                                           jnp.int32)
-            self._page_save_c = jax.jit(
-                pcounted("save", _build_page_save_fn(prefix_pages)),
-                **save_kw).lower(
-                abs_state, pool_abs, s_i32, ids_abs, s_i32, s_i32).compile()
-            self._page_load_c = jax.jit(
-                pcounted("load", _build_page_load_fn()),
-                **load_kw).lower(
-                abs_state, pool_abs, s_i32, ids_abs, s_i32).compile()
+            page_programs = page_program_table(
+                abs_state, pool_abs, n_pages=prefix_pages,
+                max_len=max_len, kv_page_size=kv_page_size, mesh=mesh,
+                counts=self.page_trace_counts)
+            self.programs.update(page_programs)
+            self._page_save_c = page_programs["save"].aot()
+            self._page_load_c = page_programs["load"].aot()
 
     # ------------------------------------------------------------- host API
 
@@ -1262,18 +1194,175 @@ def engine_state_struct(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
     return _state_struct(dec, n_slots, mesh)
 
 
+#: the prefill program's operand names (state + scalar tail) in
+#: positional order — the bundling key the analysis views use to turn a
+#: program_table entry's abstract_args into the runner's two-argument
+#: (params, ops) step shape.
+_PREFILL_OPS = ("state", "slot", "start", "chunk", "n_valid", "reset",
+                "is_last", "temp", "top_k", "top_p", "eos", "pad", "key")
+
+
+def program_table(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
+                  mesh: Optional[Mesh] = None, prefill_chunk: int = 8,
+                  spec_k: int = 0,
+                  draft_cfg: Optional[gpt.GPTConfig] = None,
+                  counts: Optional[dict] = None,
+                  abs_trees: Optional[dict] = None):
+    """Build the serve tier's core programs as fenced executor Programs.
+
+    THE one construction (ISSUE 18): ``DecodeEngine.__init__`` AOT-
+    compiles exactly this table (passing ``abs_trees`` derived from its
+    real arrays so restored-checkpoint shardings are honored), and the
+    analysis step views below enumerate the same table built from rule-
+    derived abstract trees — the fenced graph and the served graph are
+    the same construction, not hand-kept twins.
+
+    Returns ``(programs, models)``: ``programs`` maps ``decode`` (the
+    verify program when ``spec_k > 0`` — verify IS spec decode),
+    ``prefill``, and with a draft ``draft_prefill`` + ``draft``, to
+    :class:`dtf_tpu.core.executor.Program`s with their operand abstracts
+    registered; ``models`` the matching flax modules. ``counts`` is the
+    shared trace fence dict (``DecodeEngine.trace_counts``). ``probe()``
+    needs no entry: it replays the compiled decode program.
+    """
+    base = dataclasses.replace(cfg, decode_len=max_len, slot_decode=False,
+                               chunked_prefill=False)
+    dec_cfg = dataclasses.replace(base, slot_decode=True)
+    abs_trees = dict(abs_trees or {})
+    abs_params = abs_trees.get("params")
+    if abs_params is None:
+        abs_params = _abs_params(base, mesh)
+    abs_state = abs_trees.get("state")
+    if abs_state is None:
+        abs_state = _state_struct(dec_cfg, n_slots, mesh)
+    models = {
+        "decode": gpt.GPT(dec_cfg, mesh),
+        "prefill": gpt.GPT(
+            dataclasses.replace(base, chunked_prefill=True), mesh),
+    }
+    s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    s_f32 = jax.ShapeDtypeStruct((), jnp.float32)
+    s_bool = jax.ShapeDtypeStruct((), jnp.bool_)
+    chunk_abs = jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32)
+    key_abs = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    #: prefill_into_slot's scalar operand tail, shared by both prefill
+    #: programs (and re-bundled by prefill_step_view/disagg_step_view).
+    prefill_tail = (s_i32, s_i32, chunk_abs, s_i32, s_bool, s_bool,
+                    s_f32, s_i32, s_f32, s_i32, s_i32, key_abs)
+    jit_kw, verify_kw = {}, {}
+    rep = None
+    if mesh is not None:
+        # pin the OUTPUT state to the input layout: GSPMD would otherwise
+        # pick its own output shardings, and the next call of the AOT
+        # executable would reject the resharded state
+        rep = NamedSharding(mesh, P())
+        state_sh = jax.tree.map(lambda s: s.sharding, abs_state)
+        jit_kw["out_shardings"] = (state_sh, {"token": rep, "done": rep})
+        verify_kw["out_shardings"] = (state_sh,
+                                      {"tokens": rep, "done": rep,
+                                       "n_emit": rep})
+    programs = {}
+    if spec_k:
+        props_abs = jax.ShapeDtypeStruct((n_slots, spec_k), jnp.int32,
+                                         sharding=rep)
+        executor.program(
+            "decode", _build_verify_fn(models["decode"], spec_k),
+            counts=counts, jit_kw=verify_kw,
+            abstract_args=(abs_params, abs_state, props_abs),
+            table=programs)
+    else:
+        executor.program(
+            "decode", _build_decode_fn(models["decode"]),
+            counts=counts, jit_kw=jit_kw,
+            abstract_args=(abs_params, abs_state), table=programs)
+    executor.program(
+        "prefill", _build_prefill_fn(models["prefill"]),
+        counts=counts, jit_kw=jit_kw,
+        abstract_args=(abs_params, abs_state) + prefill_tail,
+        table=programs)
+    if spec_k:
+        dbase = dataclasses.replace(draft_cfg, decode_len=max_len,
+                                    slot_decode=False, chunked_prefill=False)
+        ddec_cfg = dataclasses.replace(dbase, slot_decode=True)
+        models["draft"] = gpt.GPT(ddec_cfg, mesh)
+        models["draft_prefill"] = gpt.GPT(
+            dataclasses.replace(dbase, chunked_prefill=True), mesh)
+        abs_dparams = abs_trees.get("draft_params")
+        if abs_dparams is None:
+            abs_dparams = _abs_params(dbase, mesh)
+        abs_dstate = abs_trees.get("draft_state")
+        if abs_dstate is None:
+            abs_dstate = _state_struct(ddec_cfg, n_slots, mesh)
+        dp_kw, da_kw = {}, {}
+        if mesh is not None:
+            dstate_sh = jax.tree.map(lambda s: s.sharding, abs_dstate)
+            dp_kw["out_shardings"] = (dstate_sh,
+                                      {"token": rep, "done": rep})
+            da_kw["out_shardings"] = (dstate_sh, rep)
+        vec_abs = jax.ShapeDtypeStruct((n_slots,), jnp.int32, sharding=rep)
+        executor.program(
+            "draft_prefill", _build_prefill_fn(models["draft_prefill"]),
+            counts=counts, jit_kw=dp_kw,
+            abstract_args=(abs_dparams, abs_dstate) + prefill_tail,
+            table=programs)
+        executor.program(
+            "draft", _build_draft_fn(models["draft"], spec_k),
+            counts=counts, jit_kw=da_kw,
+            abstract_args=(abs_dparams, abs_dstate, vec_abs, vec_abs),
+            table=programs)
+    return programs, models
+
+
+def page_program_table(abs_state: PyTree, pool_abs: PyTree, *,
+                       n_pages: int, max_len: int, kv_page_size: int,
+                       mesh: Optional[Mesh] = None,
+                       counts: Optional[dict] = None):
+    """The two page programs (``save``/``load``) as fenced Programs —
+    same shared-construction contract as :func:`program_table`, split out
+    because the page pool is optional (``prefix_pages > 0``) and carries
+    its own trace fence (``DecodeEngine.page_trace_counts``)."""
+    save_kw, load_kw = {}, {}
+    if mesh is not None:
+        # same pin rationale as program_table: the AOT executables must
+        # keep the pool/state in their committed layouts
+        save_kw["out_shardings"] = jax.tree.map(
+            lambda s: s.sharding, pool_abs)
+        load_kw["out_shardings"] = jax.tree.map(
+            lambda s: s.sharding, abs_state)
+    s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    ids_abs = jax.ShapeDtypeStruct((max_len // kv_page_size,), jnp.int32)
+    programs = {}
+    executor.program(
+        "save", _build_page_save_fn(n_pages), counts=counts,
+        jit_kw=save_kw,
+        abstract_args=(abs_state, pool_abs, s_i32, ids_abs, s_i32, s_i32),
+        table=programs)
+    executor.program(
+        "load", _build_page_load_fn(), counts=counts, jit_kw=load_kw,
+        abstract_args=(abs_state, pool_abs, s_i32, ids_abs, s_i32),
+        table=programs)
+    return programs
+
+
 def decode_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
                      mesh: Optional[Mesh] = None):
     """The engine's decode program as an analyzable step:
-    ``(jitted_fn, abstract_params, abstract_state)`` — what the analysis
-    registry's ``gpt_serve`` config lowers so the comms-budget fence
-    covers the serving decode graph exactly as ``DecodeEngine`` compiles
-    it (same model, same state layout, same shardings)."""
-    dec_cfg = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True)
-    model = gpt.GPT(dec_cfg, mesh)
-    step = jax.jit(_build_decode_fn(model))
-    abs_state = _state_struct(dec_cfg, n_slots, mesh)
-    return step, _abs_params(dec_cfg, mesh), abs_state
+    ``(program, abstract_params, abstract_state)`` — the ``decode``
+    entry of :func:`program_table`, so the comms-budget fence covers the
+    serving decode graph exactly as ``DecodeEngine`` compiles it (same
+    model, same state layout, same shardings, same construction)."""
+    programs, _ = program_table(cfg, n_slots=n_slots, max_len=max_len,
+                                mesh=mesh)
+    prog = programs["decode"]
+    abs_params, abs_state = prog.abstract_args
+    # the fenced view is the table's body WITHOUT the engine's output
+    # pins: the pin exists for AOT reuse (reject resharded state), but it
+    # costs extra replication all-gathers the served per-tick graph never
+    # runs (the engine feeds each output straight back in) — pinning here
+    # would charge the comms budget for transfers that don't happen.
+    view = executor.program("decode_view", prog.body,
+                            abstract_args=(abs_params, abs_state))
+    return view, abs_params, abs_state
 
 
 def _abs_params(cfg: gpt.GPTConfig, mesh: Optional[Mesh]) -> PyTree:
@@ -1304,19 +1393,18 @@ def prefill_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
     covers the known sharded-prefill resharding cost (engine docstring:
     GSPMD respells the traced-index slot slice as a resharding of the
     touched cache leaves) — previously documented, now pinned."""
-    base = dataclasses.replace(cfg, decode_len=max_len, slot_decode=False,
-                               chunked_prefill=False)
-    model = gpt.GPT(dataclasses.replace(base, chunked_prefill=True), mesh)
-    prefill_fn = _build_prefill_fn(model)
+    programs, _ = program_table(cfg, n_slots=n_slots, max_len=max_len,
+                                mesh=mesh, prefill_chunk=prefill_chunk)
+    prog = programs["prefill"]
+    abs_params, abs_state = prog.abstract_args[:2]
+    ops = dict(zip(_PREFILL_OPS, prog.abstract_args[1:]))
 
     def step(params, ops):
-        return prefill_fn(
+        return prog.body(
             params, ops["state"], ops["slot"], ops["start"], ops["chunk"],
             ops["n_valid"], ops["reset"], ops["is_last"], ops["temp"],
             ops["top_k"], ops["top_p"], ops["eos"], ops["pad"], ops["key"])
 
-    abs_state = _state_struct(
-        dataclasses.replace(base, slot_decode=True), n_slots, mesh)
     jit_kw = {}
     if mesh is not None:
         # the engine pins the output state to the input layout (its AOT
@@ -1326,21 +1414,9 @@ def prefill_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
         jit_kw["out_shardings"] = (
             jax.tree.map(lambda s: s.sharding, abs_state),
             {"token": rep, "done": rep})
-    s_i32 = jax.ShapeDtypeStruct((), jnp.int32)
-    ops = {
-        "state": abs_state,
-        "slot": s_i32, "start": s_i32,
-        "chunk": jax.ShapeDtypeStruct((prefill_chunk,), jnp.int32),
-        "n_valid": s_i32,
-        "reset": jax.ShapeDtypeStruct((), jnp.bool_),
-        "is_last": jax.ShapeDtypeStruct((), jnp.bool_),
-        "temp": jax.ShapeDtypeStruct((), jnp.float32),
-        "top_k": s_i32,
-        "top_p": jax.ShapeDtypeStruct((), jnp.float32),
-        "eos": s_i32, "pad": s_i32,
-        "key": jax.ShapeDtypeStruct((2,), jnp.uint32),
-    }
-    return jax.jit(step, **jit_kw), _abs_params(base, mesh), ops
+    return (executor.program("prefill_view", step, jit_kw=jit_kw,
+                             abstract_args=(abs_params, ops)),
+            abs_params, ops)
 
 
 def page_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
@@ -1364,8 +1440,11 @@ def page_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
     state_abs = _state_struct(dec_cfg, n_slots, mesh)
     pool_abs = pages_lib.pool_abstract(state_abs["cache"], n_pages,
                                        kv_page_size, mesh)
-    load_fn = _build_page_load_fn()
-    save_fn = _build_page_save_fn(n_pages)
+    pages = page_program_table(state_abs, pool_abs, n_pages=n_pages,
+                               max_len=max_len, kv_page_size=kv_page_size,
+                               mesh=mesh)
+    load_fn = pages["load"].body
+    save_fn = pages["save"].body
 
     def step(bundle, ops):
         st = load_fn(bundle["state"], bundle["pool"], ops["slot"],
@@ -1386,7 +1465,10 @@ def page_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
            "ids": jax.ShapeDtypeStruct((max_len // kv_page_size,),
                                        jnp.int32),
            "n_valid": s_i32, "lo": s_i32, "hi": s_i32}
-    return jax.jit(step, **jit_kw), {"state": state_abs, "pool": pool_abs}, ops
+    bundle = {"state": state_abs, "pool": pool_abs}
+    return (executor.program("page_view", step, jit_kw=jit_kw,
+                             abstract_args=(bundle, ops)),
+            bundle, ops)
 
 
 def spec_step_view(cfg: gpt.GPTConfig, draft_cfg: gpt.GPTConfig, *,
@@ -1400,11 +1482,11 @@ def spec_step_view(cfg: gpt.GPTConfig, draft_cfg: gpt.GPTConfig, *,
     scatter, the rollback assignment); the memory fence prices the
     k-token verify temp and the draft's resident cache — the numbers
     ``analysis fit`` needs to answer "max slots with spec on"."""
-    dec_cfg = dataclasses.replace(cfg, decode_len=max_len, slot_decode=True)
-    dr_base = dataclasses.replace(draft_cfg, decode_len=max_len)
-    dr_cfg = dataclasses.replace(dr_base, slot_decode=True)
-    verify_fn = _build_verify_fn(gpt.GPT(dec_cfg, mesh), spec_k)
-    draft_fn = _build_draft_fn(gpt.GPT(dr_cfg, mesh), spec_k)
+    programs, _ = program_table(cfg, n_slots=n_slots, max_len=max_len,
+                                mesh=mesh, spec_k=spec_k,
+                                draft_cfg=draft_cfg)
+    verify_fn = programs["decode"].body
+    draft_fn = programs["draft"].body
 
     def step(bundle, ops):
         dstate, props = draft_fn(bundle["draft_params"],
@@ -1413,10 +1495,9 @@ def spec_step_view(cfg: gpt.GPTConfig, draft_cfg: gpt.GPTConfig, *,
         state, out = verify_fn(bundle["params"], bundle["state"], props)
         return {"state": state, "draft_state": dstate, "out": out}
 
-    abs_state = _state_struct(dec_cfg, n_slots, mesh)
-    abs_dstate = _state_struct(dr_cfg, n_slots, mesh)
-    bundle = {"params": _abs_params(dec_cfg, mesh),
-              "draft_params": _abs_params(dr_base, mesh),
+    abs_params, abs_state = programs["decode"].abstract_args[:2]
+    abs_dparams, abs_dstate = programs["draft"].abstract_args[:2]
+    bundle = {"params": abs_params, "draft_params": abs_dparams,
               "state": abs_state, "draft_state": abs_dstate}
     vec = jax.ShapeDtypeStruct((n_slots,), jnp.int32)
     ops = {"tok": vec, "sync_index": vec}
@@ -1427,7 +1508,9 @@ def spec_step_view(cfg: gpt.GPTConfig, draft_cfg: gpt.GPTConfig, *,
             "state": jax.tree.map(lambda s: s.sharding, abs_state),
             "draft_state": jax.tree.map(lambda s: s.sharding, abs_dstate),
             "out": {"tokens": rep, "done": rep, "n_emit": rep}}
-    return jax.jit(step, **jit_kw), bundle, ops
+    return (executor.program("spec_view", step, jit_kw=jit_kw,
+                             abstract_args=(bundle, ops)),
+            bundle, ops)
 
 
 def disagg_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
@@ -1447,15 +1530,19 @@ def disagg_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
             f"max_len={max_len} (same rule as DecodeEngine)")
     base = dataclasses.replace(cfg, decode_len=max_len, slot_decode=False,
                                chunked_prefill=False)
-    prefill_fn = _build_prefill_fn(
-        gpt.GPT(dataclasses.replace(base, chunked_prefill=True), mesh))
-    save_fn = _build_page_save_fn(n_pages)
+    programs, _ = program_table(cfg, n_slots=n_slots, max_len=max_len,
+                                mesh=mesh, prefill_chunk=prefill_chunk)
+    prefill_fn = programs["prefill"].body
     state_abs = _state_struct(
         dataclasses.replace(base, slot_decode=True), n_slots, mesh)
     from dtf_tpu.serve import pages as pages_lib
 
     pool_abs = pages_lib.pool_abstract(state_abs["cache"], n_pages,
                                        kv_page_size, mesh)
+    pages = page_program_table(state_abs, pool_abs, n_pages=n_pages,
+                               max_len=max_len, kv_page_size=kv_page_size,
+                               mesh=mesh)
+    save_fn = pages["save"].body
 
     def step(bundle, ops):
         state, out = prefill_fn(
@@ -1491,4 +1578,6 @@ def disagg_step_view(cfg: gpt.GPTConfig, *, n_slots: int, max_len: int,
     }
     bundle = {"params": _abs_params(base, mesh), "state": state_abs,
               "pool": pool_abs}
-    return jax.jit(step, **jit_kw), bundle, ops
+    return (executor.program("disagg_view", step, jit_kw=jit_kw,
+                             abstract_args=(bundle, ops)),
+            bundle, ops)

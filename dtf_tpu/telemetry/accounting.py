@@ -77,6 +77,8 @@ def cost_analysis_flops(fn, *args) -> Optional[float]:
     path.
     """
     try:
+        # chipless cost analysis of a caller-owned program — no
+        # aot-ok: fence/pins/donation decision is being made here
         cost = fn.lower(*args).compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]

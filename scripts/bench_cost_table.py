@@ -36,6 +36,7 @@ V5E_PEAK_BF16_FLOPS = 197e12
 
 def _cost(fn, *args):
     """(flops, bytes_accessed) from XLA's AOT cost analysis of fn(*args)."""
+    # aot-ok: one-shot cost analysis of a bench-local program
     cost = fn.lower(*args).compile().cost_analysis()
     if isinstance(cost, (list, tuple)):
         cost = cost[0]

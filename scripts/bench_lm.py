@@ -157,6 +157,48 @@ def child():
                    grad_shard=gshard and data_size > 1 and accum > 1,
                    grad_shard_requested=gshard)
         unit_scale = batch * seq
+    elif which == "gpt_pipe":
+        # the ISSUE 18 A/B pair: fused-1F1B vs zero-bubble on the same
+        # data x pipe mesh, same model, same microbatch count — tokens/sec
+        # is the schedule delta (grads are BITWISE equal by construction,
+        # tests/test_pipeline.py). Needs >= pipe chips; a 1-chip tunnel
+        # records a structured mesh error row instead (tp-overlap idiom).
+        import dataclasses
+
+        from dtf_tpu.core.mesh import MeshConfig
+        from dtf_tpu.data.synthetic import SyntheticData
+        from dtf_tpu.models import gpt, gpt_pipe
+
+        tiny = os.environ.get("DTF_LM_TINY") == "1"  # CPU-sim logic check
+        batch = int(os.environ.get("DTF_LM_BATCH", "8"))
+        seq = int(os.environ.get("DTF_LM_SEQ", "64" if tiny else "1024"))
+        pipe = int(os.environ.get("DTF_LM_MESH_PIPE", "2"))
+        n_micro = int(os.environ.get("DTF_LM_MICRO", "4"))
+        sched = os.environ.get("DTF_LM_PIPE_SCHED", "1f1b")
+        size = os.environ.get("DTF_LM_GPT_SIZE", "small")
+        cfg = gpt.GPTConfig.tiny() if tiny else gpt.GPTConfig.by_name(size)
+        if tiny:
+            cfg = dataclasses.replace(cfg, layers=max(cfg.layers, pipe))
+        mesh = make_mesh(MeshConfig(pipe=pipe))
+        row["n_chips"] = mesh.devices.size
+        init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=seq)
+        tx = optax.adamw(1e-4, weight_decay=0.01)
+        state, shardings = tr.create_train_state(
+            init_fn, tx, jax.random.PRNGKey(0), mesh,
+            param_rules=gpt_pipe.pipe_rules())
+        maker = {"1f1b": gpt_pipe.make_pipe_grads_1f1b,
+                 "zb": gpt_pipe.make_pipe_grads_zb}[sched]
+        grads_fn = maker(cfg, mesh, n_microbatches=n_micro)
+        step = tr.make_train_step_from_grads(grads_fn, tx, mesh, shardings,
+                                             log_grad_norm=False)
+        data = shard_batch(
+            SyntheticData("gpt", batch, seed=0, seq_len=seq,
+                          vocab_size=cfg.vocab_size).batch(0), mesh)
+        row.update(batch=batch, seq=seq, gpt_size="tiny" if tiny else size,
+                   n_params=int(_count_params(state.params)),
+                   mesh_pipe=pipe, n_microbatches=n_micro,
+                   pipe_schedule=sched)
+        unit_scale = batch * seq
     else:
         from dtf_tpu.models import widedeep
 
@@ -218,8 +260,10 @@ def child():
     # MFU fields divide these flops by the measured time, so they must
     # describe the SAME computation the timing loop runs.
     try:
+        # MFU cost analysis of the very program the timing loop runs
+        # aot-ok: (bench-local, no registration surface)
         lowered = (timed.lower(state, data) if phase != "step"
-                   else step.lower(state, data))
+                   else step.lower(state, data))  # aot-ok: second leg
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
@@ -240,7 +284,7 @@ def child():
 
     per_sec = unit_scale * n_steps / dt
     row["sec_per_step"] = round(dt / n_steps, 5)
-    if which in ("bert", "gpt"):
+    if which in ("bert", "gpt", "gpt_pipe"):
         row["tokens_per_sec"] = round(per_sec, 1)
         if phase == "step":
             # analytic: 6 FLOPs per param per token (fwd+bwd, weight
@@ -391,6 +435,22 @@ def main():
              "DTF_LM_ACCUM": "4", "DTF_LM_GRAD_SHARD": "1"},
         ]
         artifact = os.path.join(ROOT, "BENCH_LM_GRAD_SHARD.json")
+    elif "--sweep-pipe" in sys.argv:
+        # the zero-bubble A/B pair (ISSUE 18): fused-1F1B vs ZB at the
+        # SAME mesh/model/microbatch count, m4 and m8 — the on-chip number
+        # that says how much of the modeled bubble shrink
+        # (PIPE_MEM.json bubble_model) survives real overlap. Needs >= 2
+        # chips; a 1-chip tunnel records a structured mesh error instead.
+        G = "gpt_pipe"
+        jobs = [
+            {"DTF_LM_WHICH": G, "DTF_LM_PIPE_SCHED": "1f1b"},
+            {"DTF_LM_WHICH": G, "DTF_LM_PIPE_SCHED": "zb"},
+            {"DTF_LM_WHICH": G, "DTF_LM_PIPE_SCHED": "1f1b",
+             "DTF_LM_MICRO": "8"},
+            {"DTF_LM_WHICH": G, "DTF_LM_PIPE_SCHED": "zb",
+             "DTF_LM_MICRO": "8"},
+        ]
+        artifact = os.path.join(ROOT, "BENCH_LM_PIPE.json")
     elif "--phases-gpt" in sys.argv:
         # fwd / fwd+bwd / full-step decomposition: pins a low MFU on fwd
         # math, bwd math, or the optimizer tail by subtraction.

@@ -13,9 +13,17 @@ This script puts numbers on that trade with XLA's own allocator report
 of the compiled fwd+bwd program), across remat on/off and two microbatch
 counts, plus the fused-1F1B schedule (``pipeline_1f1b_grads``: forwards
 and backwards interleaved in one scan, O(stages) stash, stage recompute
-built in) against the same model. Pure compile-time analysis on the CPU sim: no TPU, no probe, no
+built in) and its ZERO-BUBBLE variant (``pipeline_zb_grads``, ISSUE 18:
+backward split into B/W, W deferred into the drain bubble — one extra
+depth-S cotangent ring on top of 1F1B's stash) against the same model.
+Alongside the measured temps, ``schedule_bubble_model`` prices the IDLE
+fraction of both fused schedules at m4/m8 (pure step-count dependency
+sim, no compile): the artifact shows what the extra ZB stash buys.
+Pure compile-time analysis on the CPU sim: no TPU, no probe, no
 timing — runnable any round regardless of the tunnel. Artifact:
-``PIPE_MEM.json`` (+ one JSON line per row on stdout).
+``PIPE_MEM.json`` (+ one JSON line per row on stdout); regeneration
+MERGES by (schedule, remat, n_microbatches) key, preserving rows a
+given run doesn't re-measure.
 
 Cross-check (ISSUE 9 satellite): a GLOBAL-BATCH sweep per schedule
 family — temp measured at batch B/2 and B, extrapolated to 2B with the
@@ -88,7 +96,8 @@ def main():
                     has_aux=True)(st.params)
                 return loss, grads
 
-            mem = (jax.jit(fwdbwd).lower(state, sharded).compile()
+            mem = (jax.jit(fwdbwd)  # aot-ok: bench measurement lowering
+                   .lower(state, sharded).compile()
                    .memory_analysis())
             row = {"schedule": "gpipe", "remat": remat,
                    "n_microbatches": n_micro,
@@ -99,24 +108,28 @@ def main():
             print(json.dumps(row), flush=True)
 
             if remat:
-                continue   # 1f1b's remat is the schedule itself
-            grads_1f1b = gpt_pipe.make_pipe_grads_1f1b(
-                cfg, mesh, n_microbatches=n_micro)
+                continue   # the fused schedules' remat IS the schedule
+            for sched, maker in (
+                    ("1f1b", gpt_pipe.make_pipe_grads_1f1b),
+                    ("zb", gpt_pipe.make_pipe_grads_zb)):
+                grads_fused = maker(cfg, mesh, n_microbatches=n_micro)
 
-            def fwdbwd_1f1b(st, bt):
-                loss, _, grads = grads_1f1b(st.params, st.extra, bt,
-                                            jax.random.PRNGKey(0))
-                return loss, grads
+                def fwdbwd_fused(st, bt):
+                    loss, _, grads = grads_fused(st.params, st.extra, bt,
+                                                 jax.random.PRNGKey(0))
+                    return loss, grads
 
-            mem = (jax.jit(fwdbwd_1f1b).lower(state, sharded).compile()
-                   .memory_analysis())
-            row = {"schedule": "1f1b", "remat": False,
-                   "n_microbatches": n_micro,
-                   "temp_bytes": int(mem.temp_size_in_bytes),
-                   "arg_bytes": int(mem.argument_size_in_bytes),
-                   "out_bytes": int(mem.output_size_in_bytes)}
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+                # measurement lowering of a bench-local wrapper program
+                mem = (jax.jit(fwdbwd_fused)  # aot-ok: bench measurement
+                       .lower(state, sharded).compile()
+                       .memory_analysis())
+                row = {"schedule": sched, "remat": False,
+                       "n_microbatches": n_micro,
+                       "temp_bytes": int(mem.temp_size_in_bytes),
+                       "arg_bytes": int(mem.argument_size_in_bytes),
+                       "out_bytes": int(mem.output_size_in_bytes)}
+                rows.append(row)
+                print(json.dumps(row), flush=True)
 
     # --- batch sweep: the memory pass's affine temp model vs XLA -------
     # temp(batch) measured at B/2 and B, extrapolated to 2B, asserted
@@ -133,9 +146,10 @@ def main():
         data = SyntheticData("gpt", batch_rows, seed=0, seq_len=seq,
                              vocab_size=base.vocab_size).batch(0)
         sharded = shard_batch(data, mesh)
-        if schedule == "1f1b":
-            grads_fn = gpt_pipe.make_pipe_grads_1f1b(cfg, mesh,
-                                                     n_microbatches=4)
+        if schedule in ("1f1b", "zb"):
+            maker = (gpt_pipe.make_pipe_grads_1f1b if schedule == "1f1b"
+                     else gpt_pipe.make_pipe_grads_zb)
+            grads_fn = maker(cfg, mesh, n_microbatches=4)
 
             def fwdbwd(st, bt):
                 loss, _, grads = grads_fn(st.params, st.extra, bt,
@@ -151,14 +165,15 @@ def main():
                     has_aux=True)(st.params)
                 return loss, grads
 
-        mem = (jax.jit(fwdbwd).lower(state, sharded).compile()
+        mem = (jax.jit(fwdbwd)  # aot-ok: bench measurement lowering
+               .lower(state, sharded).compile()
                .memory_analysis())
         return int(mem.temp_size_in_bytes)
 
     predict_ok = True
     sweep = []
     for sched, remat in (("gpipe", False), ("gpipe", True),
-                         ("1f1b", False)):
+                         ("1f1b", False), ("zb", False)):
         temps = {b: temp_at(remat, sched, b)
                  for b in (batch // 2, batch, 2 * batch)}
         model = memory_pass.affine_temp_model(
@@ -175,12 +190,33 @@ def main():
         print(json.dumps(row), flush=True)
         predict_ok = predict_ok and err <= PREDICT_TOL
 
+    # --- step-count bubble model: what the extra ZB stash buys ---------
+    # pure dependency-graph sim (parallel/pipeline.schedule_bubble_model)
+    # at the measured mesh's S=2 and the ISSUE 18 reference point S=4 —
+    # ZB's modeled idle fraction must sit strictly below 1F1B's.
+    from dtf_tpu.parallel.pipeline import schedule_bubble_model
+
+    bubble_rows = []
+    zb_beats_1f1b = True
+    for n_stages in (2, 4):
+        for n_micro in (4, 8):
+            pair = {}
+            for sched in ("1f1b", "zb"):
+                m = schedule_bubble_model(n_stages, n_micro, sched)
+                pair[sched] = m
+                bubble_rows.append(m)
+                print(json.dumps(m), flush=True)
+            zb_beats_1f1b = zb_beats_1f1b and (
+                pair["zb"]["idle_frac"] < pair["1f1b"]["idle_frac"])
+
     base_row = next(r for r in rows if r["schedule"] == "gpipe"
                     and not r["remat"] and r["n_microbatches"] == 8)
     remat_row = next(r for r in rows if r["schedule"] == "gpipe"
                      and r["remat"] and r["n_microbatches"] == 8)
     f1b_row = next(r for r in rows if r["schedule"] == "1f1b"
                    and r["n_microbatches"] == 8)
+    zb_row = next(r for r in rows if r["schedule"] == "zb"
+                  and r["n_microbatches"] == 8)
     summary = {
         "config": {"d_model": base.d_model, "layers": base.layers,
                    "d_ff": base.d_ff, "seq": seq, "batch": batch,
@@ -193,17 +229,43 @@ def main():
             base_row["temp_bytes"] / max(f1b_row["temp_bytes"], 1), 2),
         "1f1b_vs_gpipe_remat_at_m8": round(
             remat_row["temp_bytes"] / max(f1b_row["temp_bytes"], 1), 2),
+        "zb_temp_overhead_vs_1f1b_at_m8": round(
+            zb_row["temp_bytes"] / max(f1b_row["temp_bytes"], 1), 2),
         "batch_sweep": sweep,
         "predict_tol": PREDICT_TOL,
         "predicted_within_tol": predict_ok,
+        "bubble_model": bubble_rows,
+        "zb_idle_below_1f1b": zb_beats_1f1b,
     }
+    # merge-preserving regeneration: rows from an older artifact that this
+    # run did NOT re-measure (other seq/batch env settings, future
+    # schedules) survive; re-measured keys are replaced in place.
+    if os.path.exists(ARTIFACT):
+        try:
+            with open(ARTIFACT) as f:
+                old = json.load(f)
+        except (OSError, ValueError):
+            old = {}
+        key = lambda r: (r.get("schedule"), r.get("remat"),
+                         r.get("n_microbatches"))
+        fresh = {key(r) for r in rows}
+        summary["rows"] = rows + [r for r in old.get("rows", ())
+                                  if key(r) not in fresh]
     with open(ARTIFACT, "w") as f:
         json.dump(summary, f, indent=1)
     print(json.dumps({"remat_temp_reduction_at_m8":
                       summary["remat_temp_reduction_at_m8"],
                       "1f1b_temp_reduction_at_m8":
                       summary["1f1b_temp_reduction_at_m8"],
+                      "zb_temp_overhead_vs_1f1b_at_m8":
+                      summary["zb_temp_overhead_vs_1f1b_at_m8"],
+                      "zb_idle_below_1f1b": zb_beats_1f1b,
                       "predicted_within_tol": predict_ok}))
+    # ISSUE 18's schedule contract: deferring W into the drain bubble
+    # must shrink modeled idle at every (S, M) this artifact prices.
+    assert zb_beats_1f1b, (
+        "zero-bubble modeled idle_frac not strictly below 1F1B's — see "
+        "PIPE_MEM.json bubble_model rows")
     # the cross-check satellite's contract: affine extrapolation must
     # track XLA's allocator — fail loudly (after writing the artifact,
     # so the rows are inspectable) when it doesn't.

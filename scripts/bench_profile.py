@@ -86,6 +86,8 @@ def child():
     # ONE AOT program: the compiled step both runs the loop and supplies
     # the optimized-HLO text whose instruction names join profiled
     # collective events back to their Python file:line (no second trace)
+    # the ONE-AOT-program contract above needs the compiled object's
+    # aot-ok: HLO text — bench-local, not a fleet program
     compiled = step.lower(state, batches[0]).compile()
     site_map = profile_site_map(compiled.as_text())
 

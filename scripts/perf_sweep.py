@@ -72,8 +72,9 @@ def child():
     # XLA's own cost model for one compiled step (only once, on the 128 run).
     if os.environ.get("DTF_PERF_COST") == "1":
         try:
+            # aot-ok: one-shot XLA cost model of the swept step
             traced = step.lower(state, data)
-            cost = traced.compile().cost_analysis()
+            cost = traced.compile().cost_analysis()  # aot-ok: cost leg
             if isinstance(cost, (list, tuple)):
                 cost = cost[0]
             row["xla_flops_per_step"] = float(cost.get("flops", 0.0))

@@ -33,6 +33,9 @@ python scripts/bench_lm.py --sweep-gpt
 python scripts/bench_lm.py --sweep-bert
 python scripts/bench_lm.py --sweep-tp-overlap
 python scripts/bench_lm.py --sweep-grad-shard
+# zero-bubble A/B (ISSUE 18): 1F1B vs ZB at m4/m8 on a data x pipe mesh
+# -> BENCH_LM_PIPE.json (multi-chip; 1-chip tunnel banks a mesh error)
+python scripts/bench_lm.py --sweep-pipe
 python scripts/bench_attention.py tpu --sweep-blocks-bwd
 python scripts/bench_decode.py
 python scripts/bench_decode.py --sweep-serve

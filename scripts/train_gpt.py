@@ -64,12 +64,15 @@ flags.DEFINE_integer("pipe_microbatches", 0, "pipeline microbatches when "
                      "default)")
 flags.DEFINE_integer("pipe_interleave", 1, "model chunks per pipe device "
                      "(Megatron interleaved schedule when >1)")
-flags.DEFINE_enum("pipe_schedule", "gpipe", ["gpipe", "1f1b"],
+flags.DEFINE_enum("pipe_schedule", "gpipe", ["gpipe", "1f1b", "zb"],
                   "pipeline schedule: gpipe (autodiff through the scan; "
-                  "O(M) activation stash, shrink it with --remat) or 1f1b "
+                  "O(M) activation stash, shrink it with --remat), 1f1b "
                   "(fused forward/backward rounds; O(stages) stash, remat "
                   "built in — for depth-sharded models that exceed HBM "
-                  "under gpipe)")
+                  "under gpipe), or zb (zero-bubble: 1f1b with the "
+                  "backward split into B/W, weight-grads deferred into "
+                  "the drain bubble — same numbers, less idle on the "
+                  "MPMD executor; docs/PIPELINE.md)")
 flags.DEFINE_integer("loss_chunk_vocab", 0, "compute the LM loss fused "
                      "with the lm_head in vocab chunks of this width "
                      "(0 = full logits). Removes the O(batch*seq*vocab) "
@@ -171,7 +174,7 @@ def main(argv):
             "--loss_chunk_vocab, --loss_chunk_tokens and --loss_pallas "
             "are mutually exclusive — pick one fused-loss strategy")
     pipelined = mesh.shape.get("pipe", 1) > 1
-    grads_fn = None   # set by --pipe_schedule=1f1b (fused fwd/bwd path)
+    grads_fn = None   # set by --pipe_schedule=1f1b/zb (fused fwd/bwd path)
     if pipelined:
         from dtf_tpu.models import gpt_pipe
 
@@ -211,16 +214,17 @@ def main(argv):
             n_micro = max(cands)
             absl_logging.info("pipeline: using %d microbatches", n_micro)
         n_stages = mesh.shape["pipe"]
-        if FLAGS.pipe_schedule == "1f1b":
+        if FLAGS.pipe_schedule in ("1f1b", "zb"):
             if FLAGS.pipe_interleave != 1 or tp_in_pipe:
                 raise app.UsageError(
-                    "--pipe_schedule=1f1b supports neither "
-                    "--pipe_interleave>1 nor --mesh_model>1; it composes "
-                    "with data and seq sharding")
+                    f"--pipe_schedule={FLAGS.pipe_schedule} supports "
+                    "neither --pipe_interleave>1 nor --mesh_model>1; it "
+                    "composes with data and seq sharding")
             if FLAGS.grad_accum != 1:
                 raise app.UsageError(
-                    "--grad_accum>1 is redundant with --pipe_schedule=1f1b "
-                    "(microbatch accumulation is the schedule); raise "
+                    "--grad_accum>1 is redundant with "
+                    f"--pipe_schedule={FLAGS.pipe_schedule} (microbatch "
+                    "accumulation is the schedule); raise "
                     "--pipe_microbatches instead")
         if tp_in_pipe:
             from dtf_tpu.models import gpt_pipe_tp
@@ -239,9 +243,11 @@ def main(argv):
             init_fn = gpt_pipe.make_pipe_init(
                 cfg, mesh, seq_len=FLAGS.seq_len,
                 interleave_v=FLAGS.pipe_interleave)
-            if FLAGS.pipe_schedule == "1f1b":
-                grads_fn = gpt_pipe.make_pipe_grads_1f1b(
-                    cfg, mesh, n_microbatches=n_micro)
+            if FLAGS.pipe_schedule in ("1f1b", "zb"):
+                maker = {"1f1b": gpt_pipe.make_pipe_grads_1f1b,
+                         "zb": gpt_pipe.make_pipe_grads_zb}[
+                             FLAGS.pipe_schedule]
+                grads_fn = maker(cfg, mesh, n_microbatches=n_micro)
                 loss_fn = None
             else:
                 loss_fn = gpt_pipe.make_pipe_loss(
@@ -337,7 +343,8 @@ def main(argv):
     if grads_fn is not None:
         if FLAGS.grad_shard:
             absl_logging.warning(
-                "--grad_shard has no effect with --pipe_schedule=1f1b "
+                "--grad_shard has no effect with --pipe_schedule="
+                f"{FLAGS.pipe_schedule} "
                 "(microbatching lives inside the fused schedule)")
         step = tr.make_train_step_from_grads(grads_fn, tx, mesh, shardings,
                                              telemetry=tel, **kwargs)

@@ -316,3 +316,47 @@ def test_1f1b_pp_x_sp_matches_sequential(kw):
         gpt_pipe.make_sequential_loss(cfg, 2, seq_shards=2),
         init_fn, mesh, gpt_pipe.pipe_rules(), batches)
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# zero-bubble schedule on real transformer stages
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pipe,layers", [(2, 4), (4, 4)])
+def test_zb_transformer_matches_1f1b_and_sequential(pipe, layers):
+    """Zero-bubble on real attention/LN/residual stages: the W/B-split
+    backward must train identically to fused-1F1B (the split only defers
+    W, the accumulate order is pinned) and to the sequential oracle."""
+    cfg = dataclasses.replace(_tiny(), layers=layers)
+    mesh = make_mesh(MeshConfig(data=8 // pipe, pipe=pipe))
+    batches = _batches(cfg, 3)
+    init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16)
+    got = _run_steps_1f1b(
+        gpt_pipe.make_pipe_grads_zb(cfg, mesh, n_microbatches=4),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    ref = _run_steps_1f1b(
+        gpt_pipe.make_pipe_grads_1f1b(cfg, mesh, n_microbatches=4),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+    want = _run_steps(
+        gpt_pipe.make_sequential_loss(cfg, pipe),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_zb_pp_x_sp_matches_sequential():
+    """ZB x SP: like 1F1B, the split backward's predicates vary only over
+    the pipe axis — per-shard ring attention inside the stages stays
+    uniform under the extra W sub-slot."""
+    cfg = dataclasses.replace(
+        gpt.GPTConfig.tiny(dtype=jnp.float32, attn_impl="auto"), layers=4)
+    mesh = make_mesh(MeshConfig(data=2, pipe=2, seq=2))
+    batches = _batches(cfg, 2)
+    init_fn = gpt_pipe.make_pipe_init(cfg, mesh, seq_len=16)
+    got = _run_steps_1f1b(
+        gpt_pipe.make_pipe_grads_zb(cfg, mesh, n_microbatches=4),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    want = _run_steps(
+        gpt_pipe.make_sequential_loss(cfg, 2, seq_shards=2),
+        init_fn, mesh, gpt_pipe.pipe_rules(), batches)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)

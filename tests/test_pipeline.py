@@ -251,3 +251,151 @@ def test_1f1b_rejects_bad_shapes(mesh_dp2_pp4):
     with pytest.raises(ValueError, match="must match"):
         run4(p_first, pp.init_stacked(make_stage_init(d), 6,
                                       jax.random.PRNGKey(0)), p_last, b)
+
+
+# ---------------------------------------------------------------------------
+# zero-bubble schedule: W/B-split backward, W deferred into the bubble
+# ---------------------------------------------------------------------------
+
+def _int_stage_fn(p, x):
+    # LINEAR stage on integer-valued f32: every product/sum is exactly
+    # representable, so grads are integer-exact and "same accumulation
+    # order" is testable as BITWISE equality (assert_array_equal).
+    return x @ p["w"] + p["b"]
+
+
+def _zb_int_setup(d, batch, n_stages, seed=11):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 6)
+    ri = lambda k, shape: jax.random.randint(k, shape, -3, 4).astype(
+        jnp.float32)
+
+    def first_fn(pf, mb):
+        return mb["x"] @ pf["e"]
+
+    def last_fn(pl, y, mb):
+        pred = y @ pl["h"]
+        return jnp.sum((pred - mb["t"]) ** 2), jnp.float32(mb["t"].shape[0])
+
+    p_first = {"e": ri(ks[0], (d, d))}
+    p_last = {"h": ri(ks[1], (d, d))}
+    p_stack = {"w": ri(ks[2], (n_stages, d, d)),
+               "b": ri(ks[3], (n_stages, d))}
+    b = {"x": ri(ks[4], (batch, d)), "t": ri(ks[5], (batch, d))}
+    return first_fn, last_fn, p_first, p_stack, p_last, b
+
+
+@pytest.mark.parametrize("pipe,micro", [(2, 4), (4, 4), (4, 8), (4, 2)])
+def test_zb_bitwise_matches_1f1b(pipe, micro):
+    # M > S, M = S, M = 2S and M < S (drain-dominated) all hit the same
+    # invariant: ZB only re-ORDERS the backward (B on the 1F1B slot, W
+    # deferred into the idle rounds, popped FIFO), so on integer data the
+    # grads are bit-for-bit the 1F1B grads.
+    mesh = make_mesh(MeshConfig(data=8 // pipe, pipe=pipe))
+    d, batch = 8, 16
+    first_fn, last_fn, p_first, p_stack, p_last, b = _zb_int_setup(
+        d, batch, pipe)
+
+    run_ref = pp.pipeline_1f1b_grads(first_fn, _int_stage_fn, last_fn,
+                                     micro, mesh)
+    run_zb = pp.pipeline_zb_grads(first_fn, _int_stage_fn, last_fn,
+                                  micro, mesh)
+    ls_r, ws_r, g_r = jax.jit(run_ref)(p_first, p_stack, p_last, b)
+    ls_z, ws_z, g_z = jax.jit(run_zb)(p_first, p_stack, p_last, b)
+
+    np.testing.assert_array_equal(np.asarray(ls_z), np.asarray(ls_r))
+    np.testing.assert_array_equal(np.asarray(ws_z), np.asarray(ws_r))
+    jax.tree.map(
+        lambda a, c: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c)), g_z, g_r)
+
+
+def test_zb_bitwise_with_remat_stage(mesh_dp2_pp4):
+    # jax.checkpoint around the stage changes where the forward is
+    # recomputed, not what is accumulated — bitwise parity must survive.
+    d, batch, micro = 8, 16, 8
+    first_fn, last_fn, p_first, p_stack, p_last, b = _zb_int_setup(
+        d, batch, 4, seed=12)
+    stage = jax.checkpoint(_int_stage_fn)
+
+    _, _, g_r = jax.jit(pp.pipeline_1f1b_grads(
+        first_fn, stage, last_fn, micro, mesh_dp2_pp4))(
+            p_first, p_stack, p_last, b)
+    _, _, g_z = jax.jit(pp.pipeline_zb_grads(
+        first_fn, stage, last_fn, micro, mesh_dp2_pp4))(
+            p_first, p_stack, p_last, b)
+    jax.tree.map(
+        lambda a, c: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c)), g_z, g_r)
+
+
+def test_zb_matches_sequential_oracle(mesh_dp2_pp4):
+    # beyond self-consistency with 1F1B: the split backward still computes
+    # THE gradient (tanh stages, float data, jax.grad oracle).
+    d, batch, micro = 8, 16, 4
+    first_fn, last_fn, p_first, p_last = _1f1b_parts(d)
+    p_stack = pp.init_stacked(make_stage_init(d), 4, jax.random.PRNGKey(1))
+    b = {"x": jax.random.normal(jax.random.PRNGKey(2), (batch, d)),
+         "t": jax.random.normal(jax.random.PRNGKey(3), (batch, d))}
+
+    run = pp.pipeline_zb_grads(first_fn, stage_fn, last_fn, micro,
+                               mesh_dp2_pp4)
+    ls, ws, grads = jax.jit(run)(p_first, p_stack, p_last, b)
+    want_l, want_g = _1f1b_ref(first_fn, last_fn, p_first, p_stack, p_last, b)
+    np.testing.assert_allclose(float(ls / ws), float(want_l), rtol=1e-5)
+    for got, want in zip(grads, want_g):
+        jax.tree.map(
+            lambda a, b_: np.testing.assert_allclose(
+                np.asarray(a) / float(ws), np.asarray(b_),
+                rtol=1e-4, atol=1e-5),
+            got, want)
+
+
+def test_zb_degenerate_single_stage_delegates():
+    # pipe axis of 1 → no bubble to fill: zb must produce exactly the
+    # 1F1B (fused value_and_grad) numbers.
+    mesh = make_mesh(MeshConfig(data=8))
+    d, batch, micro = 8, 16, 4
+    first_fn, last_fn, p_first, p_stack, p_last, b = _zb_int_setup(
+        d, batch, 1, seed=13)
+    _, _, g_r = jax.jit(pp.pipeline_1f1b_grads(
+        first_fn, _int_stage_fn, last_fn, micro, mesh))(
+            p_first, p_stack, p_last, b)
+    _, _, g_z = jax.jit(pp.pipeline_zb_grads(
+        first_fn, _int_stage_fn, last_fn, micro, mesh))(
+            p_first, p_stack, p_last, b)
+    jax.tree.map(
+        lambda a, c: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(c)), g_z, g_r)
+
+
+def test_zb_rejects_bad_shapes(mesh_dp2_pp4):
+    d = 4
+    first_fn, last_fn, p_first, p_stack, p_last, _ = _zb_int_setup(d, 16, 4)
+    run = pp.pipeline_zb_grads(first_fn, _int_stage_fn, last_fn, 3,
+                               mesh_dp2_pp4)
+    b = {"x": jnp.zeros((16, d)), "t": jnp.zeros((16, d))}
+    with pytest.raises(ValueError, match="not divisible"):
+        run(p_first, p_stack, p_last, b)
+    run4 = pp.pipeline_zb_grads(first_fn, _int_stage_fn, last_fn, 4,
+                                mesh_dp2_pp4)
+    bad_stack = {"w": jnp.zeros((6, d, d)), "b": jnp.zeros((6, d))}
+    with pytest.raises(ValueError, match="must match"):
+        run4(p_first, bad_stack, p_last, b)
+
+
+def test_zb_bubble_model():
+    # the schedule's honest accounting (the lockstep scan can't show the
+    # win): same busy work, strictly less idle at every (S, M) — and the
+    # textbook ZB-H1 numbers at S=4/M=8.
+    for s in (2, 4):
+        for m in (4, 8):
+            ref = pp.schedule_bubble_model(s, m, "1f1b")
+            zb = pp.schedule_bubble_model(s, m, "zb")
+            assert zb["busy"] == ref["busy"]
+            assert zb["idle_frac"] < ref["idle_frac"], (s, m, zb, ref)
+    ref = pp.schedule_bubble_model(4, 8, "1f1b")
+    zb = pp.schedule_bubble_model(4, 8, "zb")
+    assert ref["idle_frac"] == pytest.approx(0.2727, abs=1e-3)
+    assert zb["idle_frac"] == pytest.approx(0.1111, abs=1e-3)
+    with pytest.raises(ValueError, match="unknown schedule"):
+        pp.schedule_bubble_model(4, 8, "zbv")

@@ -97,6 +97,20 @@ def test_gpt_pp_x_sp_launcher(tmp_path):
     assert "eval_ppl" in out
 
 
+def test_gpt_zero_bubble_launcher(tmp_path):
+    """--pipe_schedule=zb end to end: the W/B-split backward trains the
+    full model through make_train_step_from_grads (grads computed inside
+    the schedule — no jax.grad), with held-out eval on the un-pipelined
+    path. Numeric parity vs 1F1B is proven in test_gpt_pipe.py; this
+    guards the launcher plumbing."""
+    out = _run("train_gpt.py", "--size=tiny", "--mesh_pipe=2",
+               "--mesh_data=4", "--pipe_schedule=zb", "--eval_every=2",
+               "--train_steps=2", "--batch_size=16", "--seq_len=32",
+               f"--logdir={tmp_path}")
+    assert "done: step=2" in out
+    assert "eval_ppl" in out
+
+
 def test_gpt_train_then_generate_round_trip(tmp_path):
     """The serve path: checkpoint from train_gpt.py decoded by
     generate_gpt.py, greedy and sampled, unsharded and dp2xtp2."""
