@@ -102,6 +102,13 @@ def _fit_main(argv: list[str]) -> int:
                              "weights (+ per-channel scale sideband), "
                              "train configs the activation-temp shrink "
                              "(docs/ANALYSIS.md, docs/TUNING.md)")
+    parser.add_argument("--log-sink", action="store_true",
+                        help="serve: price the request log sink (ISSUE "
+                             "19) next to the fleet — it is host-side "
+                             "file IO with zero device readbacks, so the "
+                             "answer is an explicit HBM no-op (the row "
+                             "exists so capacity planning can SAY so "
+                             "instead of leaving it to folklore)")
     args = parser.parse_args(argv)
 
     from dtf_tpu.analysis import configs as cfgs
@@ -118,7 +125,7 @@ def _fit_main(argv: list[str]) -> int:
             kv_page_size=args.kv_page_size, slots=args.slots, opt=args.opt,
             grad_accum=args.grad_accum, grad_shard=args.grad_shard,
             act_scale=args.act_scale, hosts=args.hosts, lost=args.lost,
-            precision=args.precision)
+            precision=args.precision, log_sink=args.log_sink)
     except Exception as e:  # noqa: BLE001 — last line must still be JSON
         print(json.dumps({"ok": False,
                           "error": f"{type(e).__name__}: {e}"[:500]}))

@@ -789,7 +789,8 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
         grad_shard: bool = False,
         act_scale: Optional[float] = None,
         hosts: Optional[int] = None, lost: int = 0,
-        precision: Optional[str] = None) -> dict:
+        precision: Optional[str] = None,
+        log_sink: bool = False) -> dict:
     """The fit planner: what fits a ``hbm_gb``-HBM chip under config
     ``name``'s mesh and sharding rules.  Serve configs answer max KV
     slots (bf16 AND int8) + page-pool size from a pure ``eval_shape``
@@ -845,11 +846,22 @@ def fit(name: str, *, hbm_gb: float, max_len: int = 1024,
         })
         out["survivor_fits_same_batch"] = out["survivor"]["fits_at_batch"]
         return out
+    if log_sink and config.fit_serve_cfg is None:
+        raise ValueError(
+            "--log-sink prices the SERVE request log sink (serve_gpt "
+            "--log_sink_dir); pick a serve config")
     if config.fit_serve_cfg is not None:
         out["kind"] = "serve"
         out.update(_fit_serve(config, hbm_bytes, max_len=max_len,
                               kv_page_size=kv_page_size, slots=slots,
                               precision=precision))
+        if log_sink:
+            # the ISSUE 19 sink is scheduler-side file IO over token ids
+            # the host already holds (the _retire record) — no device
+            # transfer, no resident tensor, no extra program. An explicit
+            # zero beats an absent row: capacity planning can CITE it.
+            out["log_sink"] = {"hbm_delta_bytes": 0,
+                               "host_side_only": True}
     else:
         out["kind"] = "train"
         out.update(_fit_train(config, hbm_bytes, opt=opt,

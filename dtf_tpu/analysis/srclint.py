@@ -242,6 +242,18 @@ def lint_file(path: str) -> list[str]:
         if in_pkg:
             problems += _backend_imports(tree, path, noqa, pkg, why)
 
+    # logsink.py is the ONE jax-free module inside serve/ (ISSUE 19):
+    # backend-free processes (distill tooling, the poison-import test)
+    # load it by file location because serve/__init__ pulls jax — a
+    # module-level backend import here would defeat that load path.
+    if base == "logsink.py" and (("serve" in dirs) if anchored
+                                 else bool(dirs) and dirs[-1] == "serve"):
+        problems += _backend_imports(
+            tree, path, noqa, "serve/logsink",
+            "the serve-log sink is host-side file IO loaded by file "
+            "location in backend-free processes; serve/__init__ owns "
+            "the jax imports")
+
     return problems
 
 

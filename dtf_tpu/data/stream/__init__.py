@@ -19,6 +19,7 @@ belongs to the Trainer.
 
 from dtf_tpu.data.stream.mixture import MIX_SALT, STATE_VERSION, MixtureStream
 from dtf_tpu.data.stream.persist import EXTRA_ITEM, StreamCheckpointHook
+from dtf_tpu.data.stream.servelog import ServeLogSource
 from dtf_tpu.data.stream.sources import TFRecordSource, TokenBinSource
 from dtf_tpu.data.stream.spec import (MANIFEST_KEY, build_stream,
                                       parse_stream_spec,
@@ -26,7 +27,7 @@ from dtf_tpu.data.stream.spec import (MANIFEST_KEY, build_stream,
 
 __all__ = [
     "MIX_SALT", "STATE_VERSION", "MixtureStream", "EXTRA_ITEM",
-    "StreamCheckpointHook", "TFRecordSource", "TokenBinSource",
-    "MANIFEST_KEY", "build_stream", "parse_stream_spec",
+    "StreamCheckpointHook", "ServeLogSource", "TFRecordSource",
+    "TokenBinSource", "MANIFEST_KEY", "build_stream", "parse_stream_spec",
     "resolve_stream_spec",
 ]

@@ -1,0 +1,296 @@
+"""Serve-log records as a resumable stream source — the flywheel's seam.
+
+The serving fleet's :class:`dtf_tpu.serve.logsink.LogSink` records every
+terminal ``done`` request into size-rotated jsonl shards; this module owns
+the RECORD CODEC both sides share and the :class:`ServeLogSource` that
+mounts a sink directory as a mixture-stream source, so "retrain on
+yesterday's traffic" is a ``--stream_spec`` edit riding the full PR 15
+determinism contract (docs/DATA.md).
+
+On-disk format (write side: ``dtf_tpu/serve/logsink.py``, exclusively
+through the ``_hostio`` choke points):
+
+- ``shard-00000.jsonl`` … — one record per line, framed
+  ``"<crc32c:08x> <body>"`` where ``body`` is compact key-sorted JSON.
+  The CRC covers the body bytes; a reader verifies it per record and a
+  mismatch SKIPS the record deterministically with one WARN (the
+  TFRecord source's bit-rot discipline, applied to jsonl).
+- ``SERVELOG_MANIFEST.json`` — the atomic commit point: the ordered list
+  of COMMITTED shards. A shard enters the manifest only once rotated (or
+  flushed) — a crash mid-rotation leaves a fully-written shard on disk
+  that the next sink over the same directory ADOPTS back into the
+  manifest, so committed records are never lost and never re-ordered.
+
+``ServeLogSource`` scans the committed shards ONCE at construction
+(verify CRC, apply the spec's filters) into an in-memory index; example
+``i`` then maps through the per-epoch permutation exactly like
+:class:`~dtf_tpu.data.stream.sources.TFRecordSource` — counter-based,
+host-free, random-access — and re-verifies the record CRC at read time
+(the ``corrupt_record`` chaos verb's :meth:`poison_next` seam).
+
+jax-free at module level (srclint-fenced with the rest of the package).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from dtf_tpu.data.sharded import epoch_order
+from dtf_tpu.data.tfrecord import crc32c
+
+log = logging.getLogger("dtf_tpu")
+
+#: the sink directory's atomic commit point (written via atomic_replace).
+MANIFEST_BASENAME = "SERVELOG_MANIFEST.json"
+
+#: manifest schema version (bump on incompatible layout changes).
+MANIFEST_VERSION = 1
+
+#: shard file naming — index-ordered so the manifest's list and a plain
+#: directory sort agree on shard order.
+SHARD_FMT = "shard-%05d.jsonl"
+
+
+# ---------------------------------------------------------------------------
+# The record codec (both sides of the flywheel import THESE two functions —
+# a sink that framed records any other way would silently strand traffic).
+# ---------------------------------------------------------------------------
+
+def encode_record(rec: dict) -> str:
+    """One serve-log record → one framed jsonl line (no trailing newline).
+
+    Body is compact key-sorted JSON so the same record always encodes to
+    the same bytes (the CRC, and therefore the corrupt-skip decisions,
+    are deterministic functions of the record's CONTENT)."""
+    body = json.dumps(rec, sort_keys=True, separators=(",", ":"))
+    return f"{crc32c(body.encode()):08x} {body}"
+
+
+def decode_record(line: str) -> Optional[dict]:
+    """Framed line → record dict, or None when the frame/CRC/JSON is
+    damaged (the caller decides whether to skip or count)."""
+    crc_hex, sep, body = line.partition(" ")
+    if not sep or len(crc_hex) != 8:
+        return None
+    try:
+        crc = int(crc_hex, 16)
+    except ValueError:
+        return None
+    if crc32c(body.encode()) != crc:
+        return None
+    try:
+        rec = json.loads(body)
+    except ValueError:
+        return None
+    return rec if isinstance(rec, dict) else None
+
+
+def shard_name(index: int) -> str:
+    return SHARD_FMT % int(index)
+
+
+def manifest_path(sink_dir: str) -> str:
+    return os.path.join(sink_dir, MANIFEST_BASENAME)
+
+
+def read_manifest(sink_dir: str) -> Optional[dict]:
+    """The committed-shard list, or None when the directory has never
+    committed one (a fresh sink dir, or one that crashed before its first
+    rotation — adoption handles the orphan shards either way)."""
+    try:
+        with open(manifest_path(sink_dir)) as f:
+            manifest = json.load(f)
+    except FileNotFoundError:
+        return None
+    if int(manifest.get("version", -1)) != MANIFEST_VERSION:
+        raise ValueError(
+            f"serve-log manifest version {manifest.get('version')!r} != "
+            f"{MANIFEST_VERSION} under {sink_dir!r}")
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# The stream source.
+# ---------------------------------------------------------------------------
+
+class ServeLogSource:
+    """LM examples over a serve-log sink directory (module docstring).
+
+    Rows come out in the shared CLM schema ``{input_ids, labels}`` (int32
+    ``[seq_len]``) so served traffic mixes freely with ``tokens``/
+    ``tfrecord`` corpora: each record's ``prompt + tokens`` concatenation
+    is windowed to ``seq_len + 1`` (the TAIL window when longer — the
+    served completion is the training signal) and padded with ``pad_id``
+    when shorter.
+
+    Filters (all spec-resolvable, manifest-authoritative on resume):
+
+    - ``status`` — record status to keep (default ``"done"``; the sink
+      only writes terminal dones today, but the filter makes the contract
+      explicit and future-proof);
+    - ``min_version``/``max_version`` — keep records decoded by param
+      versions in the closed range (None = unbounded);
+    - ``min_tokens`` — drop records with fewer completion tokens.
+
+    Records failing their CRC at SCAN time are dropped deterministically
+    with one WARN each (same bytes → same drops → same index on every
+    host and every resume); records failing at READ time (bit rot after
+    mount, or the ``corrupt_record`` verb via :meth:`poison_next`) skip
+    to the next record in epoch order, the TFRecord source's discipline.
+    """
+
+    #: bounded forward scan before giving up (a sink where this many
+    #: consecutive records rot after mount is damaged wholesale).
+    MAX_SKIP_SCAN = 64
+
+    def __init__(self, path: str, seq_len: int, *, seed: int = 0,
+                 name: Optional[str] = None, status: str = "done",
+                 min_version: Optional[int] = None,
+                 max_version: Optional[int] = None, min_tokens: int = 0,
+                 pad_id: int = 0):
+        manifest = read_manifest(path)
+        if manifest is None:
+            raise FileNotFoundError(
+                f"no {MANIFEST_BASENAME} under {path!r} — not a serve-log "
+                "sink directory (or the sink never committed a shard; "
+                "flush/close the sink, or point at the right dir)")
+        self.name = name or path
+        self.seq_len = int(seq_len)
+        self.seed = int(seed)
+        self.status = status
+        self.min_version = min_version
+        self.max_version = max_version
+        self.min_tokens = int(min_tokens)
+        self.pad_id = int(pad_id)
+        #: actual CRC-skip events on the READ path (bit rot after mount
+        #: and the injected verb alike) — aggregated into
+        #: ``MixtureStream.stats()["corrupt_skips"]``.
+        self.corrupt_skips = 0
+        #: records dropped at SCAN time (CRC damage on disk) — distinct
+        #: from read-path skips so stats tell the two stories apart.
+        self.scan_drops = 0
+        self._filtered = 0
+        self._warned: set = set()
+        self._epoch_perm: tuple = (-1, None)
+        self._poison_next = False
+        #: the index: raw framed line per ACCEPTED record, in (shard,
+        #: line) order — the addressing every host agrees on.
+        self._lines: List[str] = self._scan(path, manifest)
+        self.n_records = len(self._lines)
+        if not self.n_records:
+            raise ValueError(
+                f"{self.name}: no records under {path!r} survive the "
+                f"filters (status={status!r}, version=[{min_version}, "
+                f"{max_version}], min_tokens={min_tokens}) — an empty "
+                "source cannot feed a mixture")
+
+    # --------------------------------------------------------------- scan
+
+    def _accept(self, rec: dict) -> bool:
+        if rec.get("status", "done") != self.status:
+            return False
+        v = rec.get("version")
+        if self.min_version is not None and (v is None
+                                             or int(v) < self.min_version):
+            return False
+        if self.max_version is not None and (v is None
+                                             or int(v) > self.max_version):
+            return False
+        if len(rec.get("tokens", ())) < self.min_tokens:
+            return False
+        return True
+
+    def _scan(self, path: str, manifest: dict) -> List[str]:
+        lines: List[str] = []
+        for sh in manifest["shards"]:
+            shard = os.path.join(path, sh["name"])
+            with open(shard) as f:
+                raw = f.read()
+            for lineno, line in enumerate(raw.split("\n")):
+                if not line:
+                    continue          # the torn/empty tail line
+                rec = decode_record(line)
+                if rec is None:
+                    self.scan_drops += 1
+                    key = (sh["name"], lineno)
+                    if key not in self._warned:
+                        self._warned.add(key)
+                        log.warning(
+                            "%s: %s line %d failed its record CRC; "
+                            "dropped at scan (damaged traffic must not "
+                            "poison the run)", self.name, sh["name"],
+                            lineno)
+                    continue
+                if not self._accept(rec):
+                    self._filtered += 1
+                    continue
+                lines.append(line)
+        return lines
+
+    # --------------------------------------------------------------- reads
+
+    def poison_next(self) -> None:
+        """Arm the ``corrupt_record`` chaos verb: the next record read is
+        treated as a CRC mismatch, driving the same skip-with-WARN branch
+        post-mount bit rot takes — without touching the shard files."""
+        self._poison_next = True
+
+    def _record(self, rec: int) -> Optional[dict]:
+        if self._poison_next:
+            self._poison_next = False
+            return None
+        return decode_record(self._lines[rec])
+
+    def _record_for(self, i: int) -> int:
+        """Example index → record through the per-epoch permutation
+        (cached per epoch — the TFRecord source's idiom)."""
+        epoch, pos = divmod(i, self.n_records)
+        if self._epoch_perm[0] != epoch:
+            self._epoch_perm = (epoch, epoch_order(self.n_records,
+                                                   self.seed, epoch))
+        return int(self._epoch_perm[1][pos])
+
+    def _window(self, rec: dict) -> np.ndarray:
+        full = [int(t) for t in rec.get("prompt", ())] \
+            + [int(t) for t in rec.get("tokens", ())]
+        want = self.seq_len + 1
+        if len(full) >= want:
+            win = full[-want:]       # the tail keeps the completion
+        else:
+            win = full + [self.pad_id] * (want - len(full))
+        return np.asarray(win, np.int32)
+
+    def example(self, index: int) -> dict[str, np.ndarray]:
+        index = int(index)
+        for hop in range(self.MAX_SKIP_SCAN):
+            rec_i = self._record_for(index + hop)
+            rec = self._record(rec_i)
+            if rec is not None:
+                win = self._window(rec)
+                return {"input_ids": win[:-1], "labels": win[1:]}
+            self.corrupt_skips += 1
+            if rec_i not in self._warned:
+                self._warned.add(rec_i)
+                log.warning(
+                    "%s: record %d failed its record CRC; skipping it "
+                    "(the next record in epoch order stands in) — damaged "
+                    "traffic must not poison the run", self.name, rec_i)
+        raise ValueError(
+            f"{self.name}: {self.MAX_SKIP_SCAN} consecutive records failed "
+            f"their CRCs from example {index} — the sink is damaged "
+            "wholesale, not bit-rotted; re-capture it")
+
+    def stats(self) -> dict:
+        return {"records": self.n_records, "scan_drops": self.scan_drops,
+                "filtered": self._filtered,
+                "corrupt_skips": self.corrupt_skips}
+
+
+__all__ = ["MANIFEST_BASENAME", "MANIFEST_VERSION", "ServeLogSource",
+           "decode_record", "encode_record", "manifest_path",
+           "read_manifest", "shard_name"]

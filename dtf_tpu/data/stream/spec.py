@@ -18,8 +18,12 @@ Spec shape (JSON object, inline on the flag or a path to a ``.json`` file)::
 ``kind`` defaults to ``tokens`` (a ``.bin`` corpus / dir for
 :class:`~dtf_tpu.data.stream.sources.TokenBinSource`); ``tfrecord`` maps to
 :class:`~dtf_tpu.data.stream.sources.TFRecordSource` (packed-window records,
-``tokens_key`` optional). Weights are relative (normalized by the stream).
-``reweight`` entries are applied in order at their named steps.
+``tokens_key`` optional); ``servelog`` mounts a serve-log sink directory
+(:class:`~dtf_tpu.data.stream.servelog.ServeLogSource` — ``path`` is the
+``serve_gpt --log_sink_dir`` directory; optional filter knobs ``status``,
+``min_version``/``max_version``, ``min_tokens``, ``pad_id``). Weights are
+relative (normalized by the stream). ``reweight`` entries are applied in
+order at their named steps.
 """
 
 from __future__ import annotations
@@ -58,11 +62,11 @@ def parse_stream_spec(text: str) -> dict:
         if not isinstance(src, dict) or "name" not in src:
             raise ValueError(f"each source needs a 'name': {src!r}")
         kind = src.get("kind", "tokens")
-        if kind not in ("tokens", "tfrecord"):
+        if kind not in ("tokens", "tfrecord", "servelog"):
             raise ValueError(
                 f"source {src['name']!r}: unknown kind {kind!r} "
-                "(tokens | tfrecord)")
-        if kind == "tokens" and "path" not in src:
+                "(tokens | tfrecord | servelog)")
+        if kind in ("tokens", "servelog") and "path" not in src:
             raise ValueError(f"source {src['name']!r} needs a 'path'")
         if kind == "tfrecord" and "pattern" not in src:
             raise ValueError(f"source {src['name']!r} needs a 'pattern'")
@@ -119,6 +123,7 @@ def build_stream(spec: dict, *, global_batch: int, seq_len: int,
     """Spec → a ready :class:`~dtf_tpu.data.stream.mixture.MixtureStream`
     (sources built, weights/reweights applied, fault verb armed)."""
     from dtf_tpu.data.stream.mixture import MixtureStream
+    from dtf_tpu.data.stream.servelog import ServeLogSource
     from dtf_tpu.data.stream.sources import TFRecordSource, TokenBinSource
 
     host_view = None
@@ -137,6 +142,16 @@ def build_stream(spec: dict, *, global_batch: int, seq_len: int,
                 src["pattern"], seq_len,
                 tokens_key=src.get("tokens_key", "tokens"),
                 seed=seed + salt, name=name))
+        elif src.get("kind", "tokens") == "servelog":
+            mx = src.get("max_version")
+            mn = src.get("min_version")
+            sources.append(ServeLogSource(
+                src["path"], seq_len, seed=seed + salt, name=name,
+                status=src.get("status", "done"),
+                min_version=None if mn is None else int(mn),
+                max_version=None if mx is None else int(mx),
+                min_tokens=int(src.get("min_tokens", 0)),
+                pad_id=int(src.get("pad_id", 0))))
         else:
             path = src["path"]
             if os.path.isdir(path) or path.endswith(".bin"):

@@ -58,9 +58,15 @@ KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash",
 #: must skip it with a WARN and keep the fleet on its current version;
 #: ``wedge_in_swap@N:replica=k`` makes replica k's N-th ``swap_params``
 #: call (0-based) sleep then raise mid-swap — the Router must roll the
-#: partial fleet back onto ONE version.
+#: partial fleet back onto ONE version. The serve-log verbs (ISSUE 19):
+#: ``corrupt_log_record@N`` damages the CRC of the sink's N-th record
+#: written (0-based) — a mounting stream source must skip it with one
+#: WARN, exactly the bit-rot branch; ``crash_in_log_rotate@N`` raises
+#: after the N-th rotation's shard is durable but BEFORE its manifest
+#: commit — every committed record must survive via shard adoption.
 SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request",
-               "poison_draft", "corrupt_publish", "wedge_in_swap")
+               "poison_draft", "corrupt_publish", "wedge_in_swap",
+               "corrupt_log_record", "crash_in_log_rotate")
 
 #: the STREAMING-DATA-TIER verbs (ISSUE 15) — same env var, same grammar,
 #: targeting the mixture stream's producer (dtf_tpu/data/stream) instead

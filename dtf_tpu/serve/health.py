@@ -309,6 +309,14 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
       version the watcher observes (0-based) is damaged on disk before
       it loads. The digest check must skip it with a WARN and the fleet
       keeps serving its current version.
+    - ``corrupt_log_record@N`` — the serve-log sink's N-th record written
+      (0-based) gets a damaged CRC: a mounting
+      :class:`~dtf_tpu.data.stream.servelog.ServeLogSource` must skip it
+      with one WARN, exactly the bit-rot branch. No-op without a sink.
+    - ``crash_in_log_rotate@N`` — the sink's N-th rotation (0-based)
+      raises after the shard is durable but BEFORE its manifest commit:
+      the next sink over the directory must ADOPT the orphan shard —
+      committed records are never lost. No-op without a sink.
 
     Ticks are counted in the TARGET's own call domain (decode calls /
     submits) so plans stay deterministic under Poisson timing. ``sleep``
@@ -442,6 +450,27 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
             return _orig()
 
         watcher.load_new = load_new
+        return state
+
+    if plan.kind in ("corrupt_log_record", "crash_in_log_rotate"):
+        # the serve-log sink seams (ISSUE 19): a Router's replicas SHARE
+        # one sink — arm each DISTINCT sink once, counting in its own
+        # record/rotation domain (deterministic under Poisson timing)
+        seen_sinks: set = set()
+        for s in scheds:
+            sink = getattr(s, "_log_sink", None)
+            if sink is None or id(sink) in seen_sinks:
+                continue
+            seen_sinks.add(id(sink))
+
+            def mark(what: str) -> None:
+                state.fired = True
+                note(what)
+
+            if plan.kind == "corrupt_log_record":
+                sink.arm_corrupt(plan.tick, note=mark)
+            else:
+                sink.arm_crash_rotate(plan.tick, note=mark)
         return state
 
     delay = (wedge_s if wedge_s is not None

@@ -1,0 +1,222 @@
+"""Serve-log sink — terminal requests recorded as future training data.
+
+The write half of the flywheel (ISSUE 19; the read half is
+:class:`dtf_tpu.data.stream.servelog.ServeLogSource`): a scheduler/router
+attachment that records every terminal ``done`` request — prompt +
+completion token ids, the param version that decoded it, per-request spec
+acceptance counts, TTFT/latency, replica id — into size-rotated jsonl
+shards under one sink directory, framed by the shared record codec
+(per-record CRC32C).
+
+Durability contract (the publish-manifest discipline applied to traffic):
+
+- every byte goes through the ``_hostio`` choke points — records append
+  via :func:`~dtf_tpu._hostio.append_line` (single-writer jsonl; the
+  serve pump is one process), the manifest commits via
+  :func:`~dtf_tpu._hostio.atomic_replace`;
+- a shard enters the manifest only when ROTATED (or flushed/closed) —
+  the manifest is the atomic commit point, so a crash mid-rotation
+  (``crash_in_log_rotate`` chaos verb) leaves the fully-written shard on
+  disk and the next sink over the directory ADOPTS it back into the
+  manifest: committed records are never lost, never re-ordered, and the
+  adopted shard keeps its name (orphan shard names are never reused);
+- zero added device readbacks: every recorded fact is a host int/float
+  the scheduler already holds (token ids cross the device boundary once,
+  in the decode tick's existing ``int()`` conversions — the PR 5 idiom).
+
+All values recorded are HOST facts handed in by the scheduler — the sink
+itself never touches a clock, an rng, or a device. jax-free at module
+level: ``dtf_tpu.serve.__init__`` pulls the engine (and jax), so import
+this module directly (``dtf_tpu.serve.logsink``) from no-backend
+contexts; srclint fences its import list like ``fault/``+``data/stream``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+from dtf_tpu._hostio import append_line, atomic_replace
+from dtf_tpu.fault.inject import InjectedCrash
+from dtf_tpu.data.stream.servelog import (MANIFEST_VERSION, decode_record,
+                                          encode_record, manifest_path,
+                                          read_manifest, shard_name)
+
+log = logging.getLogger("dtf_tpu")
+
+
+class LogSink:
+    """Size-rotated serve-log writer over one sink directory.
+
+    ``rotate_bytes`` bounds a shard's payload (the check runs after each
+    append, so one oversized record still lands whole); ``0`` disables
+    rotation — everything rides one shard committed at :meth:`flush`/
+    :meth:`close`. One sink per directory per process (the ``append_line``
+    single-writer contract); a Router's replicas SHARE one sink — the
+    pump is one thread, and records carry their replica id.
+    """
+
+    def __init__(self, sink_dir: str, *, rotate_bytes: int = 1 << 20):
+        self.dir = os.fspath(sink_dir)
+        self.rotate_bytes = int(rotate_bytes)
+        manifest = read_manifest(self.dir)
+        self._shards: list = list(manifest["shards"]) if manifest else []
+        self._adopted = self._adopt_orphans()
+        #: the OPEN shard: next index after every shard on disk —
+        #: committed or orphaned — so a crashed rotation's name is never
+        #: reused (two generations of records must never interleave).
+        self._shard_index = self._next_index()
+        self._open_records = 0
+        self._open_bytes = 0
+        self._records = 0
+        self._rotations = 0
+        #: chaos seams (install_serve_fault): damage the CRC of the N-th
+        #: record written / crash after the N-th rotation's shard is
+        #: durable but BEFORE its manifest commit.
+        self._corrupt_at: Optional[int] = None
+        self._crash_rotate_at: Optional[int] = None
+        self._fault_note = None
+        self._injected_corrupt = 0
+
+    # ----------------------------------------------------------- recovery
+
+    def _adopt_orphans(self) -> int:
+        """Fold fully-written shards a crashed rotation left uncommitted
+        back into the manifest (module docstring). Record counts are
+        re-derived from the shard's CRC-valid lines."""
+        try:
+            on_disk = sorted(n for n in os.listdir(self.dir)
+                             if n.startswith("shard-")
+                             and n.endswith(".jsonl"))
+        except FileNotFoundError:
+            return 0
+        committed = {s["name"] for s in self._shards}
+        adopted = 0
+        for name in on_disk:
+            if name in committed:
+                continue
+            n = self._count_records(os.path.join(self.dir, name))
+            self._shards.append({"name": name, "records": n})
+            adopted += 1
+            log.warning(
+                "serve-log sink %s: adopted orphan shard %s (%d records) "
+                "— a previous sink crashed between the shard write and "
+                "its manifest commit; committed records are never lost",
+                self.dir, name, n)
+        if adopted:
+            self._shards.sort(key=lambda s: s["name"])
+            self._commit_manifest()
+        return adopted
+
+    @staticmethod
+    def _count_records(path: str) -> int:
+        with open(path) as f:
+            return sum(1 for line in f.read().split("\n")
+                       if line and decode_record(line) is not None)
+
+    def _next_index(self) -> int:
+        try:
+            on_disk = [n for n in os.listdir(self.dir)
+                       if n.startswith("shard-") and n.endswith(".jsonl")]
+        except FileNotFoundError:
+            on_disk = []
+        idx = [int(n[len("shard-"):-len(".jsonl")]) for n in on_disk
+               if n[len("shard-"):-len(".jsonl")].isdigit()]
+        return max(idx) + 1 if idx else 0
+
+    # ------------------------------------------------------------ writing
+
+    def record(self, rec: dict) -> None:
+        """Append one terminal-request record (host facts only — the
+        scheduler's ``_retire`` hands in ints/floats it already holds)."""
+        line = encode_record(rec)
+        if self._corrupt_at is not None and self._records == self._corrupt_at:
+            # the corrupt_log_record verb: flip the CRC nibbles so the
+            # body survives but the frame fails verification — readers
+            # must take the skip-with-WARN branch, exactly like bit rot
+            self._corrupt_at = None
+            self._injected_corrupt += 1
+            crc_hex, _, body = line.partition(" ")
+            line = f"{int(crc_hex, 16) ^ 0xFFFFFFFF:08x} {body}"
+            self._note("corrupt_log_record")
+        append_line(os.path.join(self.dir, shard_name(self._shard_index)),
+                    line)
+        self._records += 1
+        self._open_records += 1
+        self._open_bytes += len(line) + 1
+        if self.rotate_bytes and self._open_bytes >= self.rotate_bytes:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Commit the open shard to the manifest and start the next one.
+        The shard bytes are already durable (every record appended as it
+        arrived) — the manifest replace IS the commit point, so the
+        injected crash lands between the two and adoption must recover."""
+        self._shards.append({"name": shard_name(self._shard_index),
+                             "records": self._open_records})
+        rotation = self._rotations
+        self._rotations += 1
+        self._shard_index += 1
+        self._open_records = 0
+        self._open_bytes = 0
+        if (self._crash_rotate_at is not None
+                and rotation == self._crash_rotate_at):
+            self._crash_rotate_at = None
+            self._note("crash_in_log_rotate")
+            raise InjectedCrash(
+                f"injected crash mid-rotation of serve-log shard "
+                f"{self._shards[-1]['name']} (the shard is durable; the "
+                "manifest commit never ran — adoption must recover it)")
+        self._commit_manifest()
+
+    def _commit_manifest(self) -> None:
+        atomic_replace(manifest_path(self.dir), json.dumps({
+            "version": MANIFEST_VERSION,
+            "shards": self._shards,
+            "records": int(sum(s["records"] for s in self._shards)),
+        }, indent=1, sort_keys=True))
+
+    def flush(self) -> None:
+        """Commit the open shard (if it holds records) so a mounting
+        :class:`ServeLogSource` sees everything recorded so far."""
+        if self._open_records:
+            self._rotate()
+
+    def close(self) -> None:
+        self.flush()
+
+    # -------------------------------------------------------------- chaos
+
+    def arm_corrupt(self, nth: int, note=None) -> None:
+        """``corrupt_log_record@N``: damage the CRC of the N-th record
+        written (0-based, sink lifetime)."""
+        self._corrupt_at = int(nth)
+        self._fault_note = note
+
+    def arm_crash_rotate(self, nth: int, note=None) -> None:
+        """``crash_in_log_rotate@N``: raise after the N-th rotation's
+        shard is durable but before its manifest commit (0-based)."""
+        self._crash_rotate_at = int(nth)
+        self._fault_note = note
+
+    def _note(self, what: str) -> None:
+        if self._fault_note is not None:
+            self._fault_note(what)
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        """Host counters for the launcher JSON line (zero device work)."""
+        return {
+            "records": self._records,
+            "shards_committed": len(self._shards),
+            "open_records": self._open_records,
+            "rotations": self._rotations,
+            "adopted_shards": self._adopted,
+            "injected_corrupt": self._injected_corrupt,
+        }
+
+
+__all__ = ["LogSink"]

@@ -120,7 +120,8 @@ class Router:
     def __init__(self, engines: Sequence[DecodeEngine], writer=None, *,
                  telemetry=None, ttft_slo_s: float = 0.0,
                  clock=time.monotonic, health=None,
-                 prefill_replicas: int = 0, **scheduler_kw):
+                 prefill_replicas: int = 0, log_sink=None,
+                 **scheduler_kw):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
         # prefill/decode DISAGGREGATION: the FIRST ``prefill_replicas``
@@ -149,11 +150,16 @@ class Router:
                        for i in range(len(engines))]
         self.telemetry = telemetry
         self.clock = clock
+        #: ONE serve-log sink shared by the fleet (ISSUE 19): the pump is
+        #: one thread, records carry their replica id, and a single shard
+        #: sequence keeps the mounted stream source's addressing global.
+        self.log_sink = log_sink
         self.schedulers = [
             Scheduler(e, writer, telemetry=telemetry,
                       ttft_slo_s=ttft_slo_s, clock=clock,
-                      postmortem_name=None, **scheduler_kw)
-            for e in engines]
+                      postmortem_name=None, log_sink=log_sink,
+                      replica_index=i, **scheduler_kw)
+            for i, e in enumerate(engines)]
         # replica health: ON by default for a real fleet (>1 replica —
         # quarantine needs survivors to requeue onto); pass a
         # HealthConfig to tune thresholds or force it for a single
@@ -571,6 +577,38 @@ class Router:
                  cfg.canary_ticks)
         return version
 
+    def maybe_swap_draft(self, watcher, *,
+                         config: Optional[SwapConfig] = None
+                         ) -> Optional[int]:
+        """Poll a :class:`dtf_tpu.publish.PublishWatcher` mounted on a
+        DRAFT publish directory (``train_gpt --distill_draft``'s output)
+        and roll a **draft-only** swap when it hands over a new version:
+        the fleet's base params ride the transaction UNCHANGED and only
+        ``draft_params`` flips, so emitted tokens are byte-identical by
+        construction (the verifier owns the rng chain) and acceptance is
+        the only thing that moves. The fleet version still advances by
+        one (monotone — records stamp which draft served them, and the
+        prefix-page epoch rolls with it); the watcher is credited with
+        ITS version number, which need not match the fleet's."""
+        if self._swap is not None:
+            return None
+        got = watcher.load_new()
+        if got is None:
+            return None
+        dversion, step, draft_params = got
+        # every replica shares ONE base tree by construction — replica
+        # 0's live params ARE the fleet's params
+        base = self.schedulers[0].engine._params
+        v = self.start_swap(base, version=self._version + 1,
+                            draft_params=draft_params, config=config)
+        self._swap["watcher"] = watcher
+        self._swap["watcher_version"] = dversion
+        self._swap["step"] = step
+        log.info("draft-only rolling swap started: draft publish version "
+                 "%d rides fleet version %d (base params unchanged)",
+                 dversion, v)
+        return v
+
     def maybe_swap_published(self, watcher, *,
                              config: Optional[SwapConfig] = None,
                              draft_factory=None) -> Optional[int]:
@@ -768,8 +806,10 @@ class Router:
                            "outcome": "rolled_back", "cause": cause}
         if sw["watcher"] is not None:
             # a rolled-back version must not immediately re-swap on the
-            # next poll: only a NEWER republish may try again
-            sw["watcher"].skipped.add(sw["version"])
+            # next poll: only a NEWER republish may try again (a draft
+            # watcher is credited in ITS version numbering)
+            sw["watcher"].skipped.add(sw.get("watcher_version",
+                                            sw["version"]))
         self._invalidate_stale_pages()
 
     def _commit_swap(self) -> None:
@@ -779,7 +819,8 @@ class Router:
         self._swaps += 1
         self._last_swap = {"version": sw["version"], "outcome": "done"}
         if sw["watcher"] is not None:
-            sw["watcher"].note_applied(sw["version"])
+            sw["watcher"].note_applied(sw.get("watcher_version",
+                                              sw["version"]))
         self._invalidate_stale_pages()
         log.info("rolling swap complete: fleet serving param version %d",
                  sw["version"])
@@ -955,6 +996,17 @@ class Router:
                     for k, v in s.engine.page_trace_counts.items()}}
                 for s in self.schedulers]
 
+    def accept_by_version(self) -> dict:
+        """Fleet-summed per-version speculative acceptance counts,
+        ``{version: (proposed, accepted)}`` (ISSUE 19) — the raw ints
+        behind ``router_spec_accept_rate_v{N}``."""
+        fleet: dict = {}
+        for s in self.schedulers:
+            for v, (prop, acc) in s.accept_by_version().items():
+                cur = fleet.get(v, (0, 0))
+                fleet[v] = (cur[0] + prop, cur[1] + acc)
+        return dict(sorted(fleet.items()))
+
     def stats(self, brief: bool = False) -> dict:
         """Fleet aggregates + the ``replica{i}_*`` SLO panel."""
         n = len(self.schedulers)
@@ -1014,6 +1066,14 @@ class Router:
             out["router_ttft_slo_ok_frac"] = (
                 sum(1 for t in ttfts if t <= self.ttft_slo_s) / len(ttfts)
                 if ttfts else 1.0)
+        # the flywheel panel (ISSUE 19): fleet per-version acceptance —
+        # a distilled draft's swap shows up as rate_v{new} > rate_v{old}
+        for v, (prop, acc) in self.accept_by_version().items():
+            if prop:
+                out[f"router_spec_accept_rate_v{v}"] = acc / prop
+        if self.log_sink is not None:
+            out["router_log_sink_records"] = float(
+                self.log_sink.stats()["records"])
         # fleet-summed engine counters (prefill chunks, page hits, ...)
         counters: dict = {}
         for s in self.schedulers:

@@ -150,6 +150,13 @@ class _Rec:
     #: swapping it, so a request spanning the boundary replays whole on
     #: one version (tokens cleared on requeue).
     version: Optional[int] = None
+    #: per-request speculative accounting (ISSUE 19): proposals the draft
+    #: made for this request and how many the verifier accepted — host
+    #: ints mirrored off the scheduler's fleet counters, recorded into
+    #: the serve-log sink so draft distillation can weigh its examples.
+    #: Cleared on requeue with the tokens (the replay regenerates both).
+    proposed: int = 0
+    accepted: int = 0
 
 
 class Scheduler:
@@ -165,9 +172,16 @@ class Scheduler:
                  completed_cap: int = 100_000, telemetry=None,
                  ttft_slo_s: float = 0.0, max_queue: int = 0,
                  shed_retry_after_s: float = 0.25,
-                 postmortem_name: Optional[str] = "serve_scheduler"):
+                 postmortem_name: Optional[str] = "serve_scheduler",
+                 log_sink=None, replica_index: int = 0):
         self.engine = engine
         self.writer = writer
+        #: serve-log sink (ISSUE 19): every terminal ``done`` request is
+        #: recorded as future training data — host facts only, zero added
+        #: device readbacks (the token ints already crossed in tick()).
+        #: A Router threads ONE shared sink here with per-replica indices.
+        self._log_sink = log_sink
+        self.replica_index = int(replica_index)
         self.log_every = log_every
         self.telemetry = telemetry
         if telemetry is not None and postmortem_name:
@@ -242,6 +256,11 @@ class Scheduler:
         # engine's own counters also see stale still-active rows)
         self._spec_proposed = 0
         self._spec_accepted = 0
+        #: acceptance bucketed by the engine's param version at proposal
+        #: time (ISSUE 19): {version: [proposed, accepted]} — the
+        #: per-version panel that shows a distilled draft's acceptance
+        #: climbing across a draft-only swap.
+        self._accept_by_version: dict[int, list] = {}
         # deadline sweeps only run once a deadlined request has been seen
         self._any_deadlines = False
 
@@ -424,10 +443,16 @@ class Scheduler:
                 # in order until the row's eos or budget — exactly the
                 # sequence n_emit plain ticks would have delivered.
                 toks, dones, n_emit = out
+                ver = int(getattr(self.engine, "param_version", 0) or 0)
+                bucket = self._accept_by_version.setdefault(ver, [0, 0])
                 for slot, rec in list(self._running.items()):
                     n = int(n_emit[slot])
                     self._spec_proposed += spec_k
                     self._spec_accepted += n - 1
+                    rec.proposed += spec_k
+                    rec.accepted += n - 1
+                    bucket[0] += spec_k
+                    bucket[1] += n - 1
                     for j in range(n):
                         rec.tokens.append(int(toks[slot, j]))
                         if bool(dones[slot, j]) or self._budget_spent(rec):
@@ -535,6 +560,22 @@ class Scheduler:
             # engine's CURRENT version is the whole request's version
             # because a swap drains in-flight work first (see _Rec)
             rec.version = getattr(self.engine, "param_version", None)
+            if self._log_sink is not None:
+                # the flywheel's write point (ISSUE 19): every fact here
+                # is a host int/float the scheduler already holds
+                self._log_sink.record({
+                    "rid": rec.trace_id if rec.trace_id >= 0 else rec.rid,
+                    "replica": self.replica_index,
+                    "version": rec.version,
+                    "status": status,
+                    "prompt": [int(t) for t in rec.req.prompt],
+                    "tokens": list(rec.tokens),
+                    "ttft_s": round(rec.first_token_t - rec.submit_t, 6)
+                    if rec.first_token_t is not None else None,
+                    "latency_s": round(rec.finish_t - rec.submit_t, 6),
+                    "proposed": rec.proposed,
+                    "accepted": rec.accepted,
+                })
         tracer = self._tracer()
         if tracer is not None:
             # the request's whole lifecycle as ONE slice on its own track
@@ -646,6 +687,8 @@ class Scheduler:
             rec.pages_loaded = 0
             rec.slot = -1
             rec.tokens = []
+            rec.proposed = 0
+            rec.accepted = 0
             rec.status = "requeued"
             self._requeued_out += 1
         return recs
@@ -691,6 +734,13 @@ class Scheduler:
 
     # --------------------------------------------------------------- metrics
 
+    def accept_by_version(self) -> dict:
+        """Per-param-version speculative acceptance counts,
+        ``{version: (proposed, accepted)}`` — raw ints so a Router can
+        fleet-sum them (the rate panel lives in :meth:`stats`)."""
+        return {v: (b[0], b[1])
+                for v, b in sorted(self._accept_by_version.items())}
+
     def stats(self, brief: bool = False) -> dict:
         """Aggregate serving metrics (floats, MetricWriter-compatible)."""
         out = {
@@ -722,6 +772,9 @@ class Scheduler:
         if self._spec_proposed:
             out["serve_spec_accept_rate"] = (self._spec_accepted
                                              / self._spec_proposed)
+        for v, (prop, acc) in sorted(self._accept_by_version.items()):
+            if prop:
+                out[f"serve_spec_accept_rate_v{v}"] = acc / prop
         if self.ttft_slo_s > 0.0:
             out["serve_ttft_slo_ok_frac"] = (
                 sum(1 for t in self._ttfts if t <= self.ttft_slo_s)

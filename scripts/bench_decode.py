@@ -27,7 +27,10 @@ into ``BENCH_LM.json`` under ``"serve"``. The sweep spans replica count
 (engines behind the Router, slots split so capacity is constant) and
 prefix-hit ratio (shared prompt stems; hit rows carry an extra
 ``serve_off`` side — same arrivals, page cache off — so the prefill-work
-and TTFT p50 deltas are in-row).
+and TTFT p50 deltas are in-row). The ``DTF_SERVE_LOG_SINK=1`` row (ISSUE
+19) attaches the request log sink to the fleet vs the same fleet without
+it: host-side appends with zero device readbacks, fenced as a ~zero
+goodput/TTFT delta.
 """
 
 import json
@@ -198,6 +201,11 @@ def child_serve():
         raise SystemExit("DTF_SERVE_SWAP needs DTF_SERVE_REPLICAS >= 2 "
                          "(a rolling swap drains one replica while the "
                          "others serve)")
+    # ISSUE 19 axis: attach the request log sink to the serve side — the
+    # A/B partner is the same fleet with the sink off. The sink is
+    # host-side file IO with zero device readbacks, so the claim under
+    # measurement is a ~zero goodput/TTFT delta, not a win.
+    log_sink_on = os.environ.get("DTF_SERVE_LOG_SINK") == "1"
     # long-prompt BURST (the disaggregation row's workload): a contiguous
     # run of requests mid-stream carries a LONG unique prompt; the row
     # then reports short-request TTFT separately — the starvation metric
@@ -292,8 +300,15 @@ def child_serve():
             jax.numpy.zeros((1, 1), jax.numpy.int32))["params"]
 
     def serve_side(prefix_on, inject=False, disagg=0, spec_on=True,
-                   swap=False):
+                   swap=False, sink_on=False):
         use_spec = spec_k if spec_on else 0
+        sink = None
+        if sink_on:
+            import shutil
+            import tempfile
+
+            from dtf_tpu.serve.logsink import LogSink
+            sink = LogSink(tempfile.mkdtemp(prefix="dtf_bench_sink_"))
         pool = (max_len // page) * 2 if prefix_on else 0
         # on a disaggregation ROW, both sides get eager saves AND the
         # shared store — the off side must differ ONLY in phase routing,
@@ -334,10 +349,10 @@ def child_serve():
         if replicas > 1:
             sched = Router(engines, None, prefill_chunks_per_tick=budget,
                            health=health, max_queue=fault_queue,
-                           prefill_replicas=disagg)
+                           prefill_replicas=disagg, log_sink=sink)
         else:
             sched = Scheduler(engines[0], None, prefill_chunks_per_tick=budget,
-                              max_queue=fault_queue)
+                              max_queue=fault_queue, log_sink=sink)
         if inject:
             # wedge sleeps are real wall time (the watchdog quarantines
             # on measured tick duration); installed AFTER warm-up so the
@@ -453,6 +468,12 @@ def child_serve():
                                      st.get("serve_timeouts", 0.0))
             out["quarantines"] = st.get("router_quarantines", 0.0)
             out["requeued"] = st.get("router_requeued", 0.0)
+        if sink is not None:
+            sink.close()
+            sk = sink.stats()
+            out["log_sink_records"] = sk["records"]
+            out["log_sink_shards"] = sk["shards_committed"]
+            shutil.rmtree(sink.dir, ignore_errors=True)
         return out
 
     # ---- serve side: open-loop Poisson against the engine/router fleet.
@@ -461,7 +482,7 @@ def child_serve():
     # against pages off, a spec row against speculation off — always the
     # same seeded arrivals.
     serve = serve_side(prefix_on=hit_ratio > 0, disagg=prefill_reps,
-                       swap=swap_at > 0)
+                       swap=swap_at > 0, sink_on=log_sink_on)
     if swap_at:
         # the swap A/B: the SAME fleet shape (disagg axis included), same
         # arrivals, no swap — the TTFT p99 delta between the sides is
@@ -472,6 +493,12 @@ def child_serve():
         serve_off = serve_side(prefix_on=True, disagg=0)
     elif spec_k:
         serve_off = serve_side(prefix_on=hit_ratio > 0, spec_on=False)
+    elif log_sink_on:
+        # the log-sink A/B (ISSUE 19): same fleet, sink off — the sink is
+        # host-side appends with zero device readbacks, so the fence here
+        # is "recording traffic costs ~nothing", read as the goodput/TTFT
+        # delta between the sides
+        serve_off = serve_side(prefix_on=hit_ratio > 0)
     elif hit_ratio > 0:
         serve_off = serve_side(prefix_on=False)
     else:
@@ -596,6 +623,11 @@ def main(key="decode"):
             # vs the no-swap side on the same seeded arrivals (the
             # zero-downtime fence), all requests terminal `done`
             {"DTF_SERVE_REPLICAS": "2", "DTF_SERVE_SWAP": "6"},
+            # log-sink A/B (ISSUE 19): the same fleet records every done
+            # request into a serve-log sink vs not — host-side jsonl
+            # appends, zero device readbacks, so the fenced claim is a
+            # ~zero goodput/TTFT delta (the flywheel's capture is free)
+            {"DTF_SERVE_REPLICAS": "2", "DTF_SERVE_LOG_SINK": "1"},
             # ISSUE 13: draft-k sweep — each row carries a spec-off side
             # on the same arrivals; self-draft is the acceptance upper
             # bound (measures the machinery), and the tuner's spec_k

@@ -179,6 +179,22 @@ flags.DEFINE_string("trace_out", "", "write a Perfetto-loadable "
                     "wait, prefill chunks, decode steps, all tagged with "
                     "end-to-end trace ids) to this path; implies the "
                     "request TraceCollector is on")
+flags.DEFINE_string("log_sink_dir", "", "serve-traffic log sink (ISSUE "
+                    "19): every terminal request is appended (prompt + "
+                    "completion token ids, param version, spec acceptance "
+                    "counts, TTFT/latency, replica id) to CRC-framed, "
+                    "size-rotated shards under this dir — mountable as "
+                    "the 'servelog' stream source for draft distillation "
+                    "(docs/DATA.md). Host-side only: zero added device "
+                    "readbacks")
+flags.DEFINE_string("draft_publish_dir", "", "poll this publish dir for "
+                    "DISTILLED DRAFT versions (train_gpt --distill_draft "
+                    "writes them) and roll DRAFT-ONLY swaps across the "
+                    "fleet: the base weights ride the transaction "
+                    "unchanged, so emitted tokens stay byte-identical and "
+                    "only acceptance rate moves; needs --swap_poll_ticks, "
+                    "--replicas >= 2 and a draft (--draft_ckpt or "
+                    "--draft_layers) — docs/SERVING.md")
 FLAGS = flags.FLAGS
 
 
@@ -217,15 +233,27 @@ def main(argv):
             "--publish_version needs --publish_dir (it names a PUBLISHED "
             "version, not a checkpoint step)")
     if FLAGS.swap_poll_ticks:
-        if not FLAGS.publish_dir:
+        if not FLAGS.publish_dir and not FLAGS.draft_publish_dir:
             raise app.UsageError(
-                "--swap_poll_ticks needs --publish_dir (there is nothing "
-                "to poll for new versions without a publish dir)")
+                "--swap_poll_ticks needs --publish_dir or "
+                "--draft_publish_dir (there is nothing to poll for new "
+                "versions without a publish dir)")
         if FLAGS.replicas < 2:
             raise app.UsageError(
                 "--swap_poll_ticks needs --replicas >= 2: a rolling swap "
                 "drains one replica while the others serve (a single "
                 "engine cannot swap with zero downtime)")
+    if FLAGS.draft_publish_dir:
+        if not FLAGS.swap_poll_ticks:
+            raise app.UsageError(
+                "--draft_publish_dir needs --swap_poll_ticks > 0 (the "
+                "draft watcher polls on the same cadence as the weight "
+                "swap poller)")
+        if not (FLAGS.draft_ckpt or FLAGS.draft_layers):
+            raise app.UsageError(
+                "--draft_publish_dir rolls DRAFT-ONLY swaps; the fleet "
+                "needs a draft to replace — pass --draft_ckpt or "
+                "--draft_layers")
     try:
         # kv dtype + page-size legality checked HERE (against the manifest
         # architecture and the serving shape), not inside the AOT build.
@@ -308,6 +336,10 @@ def main(argv):
             raise app.UsageError(f"draft manifest size: {e.args[0]}")
         draft_cfg = dataclasses.replace(
             dbase,
+            # a DISTILLED draft (train_gpt --distill_draft) names its
+            # base's size but is truncated in depth — the manifest's
+            # explicit layer count wins over the preset's
+            layers=int(dmanifest.get("layers", dbase.layers)),
             kv_heads=dmanifest.get("kv_heads") or None,
             attn_window=int(dmanifest.get("attn_window", 0) or 0),
             attn_global_every=int(
@@ -364,6 +396,14 @@ def main(argv):
         if FLAGS.trace_out:
             tel.tracer = TraceCollector()
     writer = MetricWriter(None, also_log=False)
+    # the serve-traffic log sink (ISSUE 19): one sink for the whole fleet
+    # (the pump is single-threaded; records carry their replica id) so
+    # the shard sequence a mounted 'servelog' source addresses is global
+    sink = None
+    if FLAGS.log_sink_dir:
+        from dtf_tpu.serve.logsink import LogSink
+
+        sink = LogSink(FLAGS.log_sink_dir)
     try:
         if FLAGS.replicas > 1:
             from dtf_tpu.serve import HealthConfig, Router
@@ -393,7 +433,8 @@ def main(argv):
                 prefill_replicas=FLAGS.prefill_replicas,
                 writer=writer, telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
                 health=health, max_queue=FLAGS.max_queue,
-                prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick)
+                prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
+                log_sink=sink)
             engines = [s.engine for s in sched.schedulers]
         else:
             engines = [DecodeEngine(
@@ -406,7 +447,7 @@ def main(argv):
                 engines[0], writer, log_every=0,
                 prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
                 telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
-                max_queue=FLAGS.max_queue)
+                max_queue=FLAGS.max_queue, log_sink=sink)
     except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
         raise app.UsageError(str(e))
     if served_version:
@@ -427,13 +468,21 @@ def main(argv):
     # starts a rolling swap across the fleet — the serve loop itself
     # never pauses (docs/SERVING.md "Rolling weight swap")
     watcher = None
+    draft_watcher = None
     swap_tick = None
     if FLAGS.swap_poll_ticks:
         from dtf_tpu.publish import PublishWatcher
         from dtf_tpu.serve import SwapConfig
 
-        watcher = PublishWatcher(FLAGS.publish_dir,
-                                 applied_version=served_version)
+        if FLAGS.publish_dir:
+            watcher = PublishWatcher(FLAGS.publish_dir,
+                                     applied_version=served_version)
+        if FLAGS.draft_publish_dir:
+            # the flywheel's return path (ISSUE 19): distilled drafts
+            # published by train_gpt --distill_draft roll through
+            # Router.maybe_swap_draft — base weights untouched, tokens
+            # byte-identical, the acceptance panel shows the payoff
+            draft_watcher = PublishWatcher(FLAGS.draft_publish_dir)
         # with a TTFT SLO configured, --ttft_slo_frac doubles as the
         # canary's rollback floor (the same compliance fraction the
         # heartbeat warns on); health verdicts gate regardless
@@ -450,8 +499,11 @@ def main(argv):
         def swap_tick():
             ticks[0] += 1
             if ticks[0] % FLAGS.swap_poll_ticks == 0:
-                sched.maybe_swap_published(watcher, config=swap_cfg,
-                                           draft_factory=draft_factory)
+                if watcher is not None:
+                    sched.maybe_swap_published(watcher, config=swap_cfg,
+                                               draft_factory=draft_factory)
+                if draft_watcher is not None:
+                    sched.maybe_swap_draft(draft_watcher, config=swap_cfg)
 
     # serve-side chaos (DTF_FAULT_INJECT=wedge_replica@tick:replica=k |
     # slow_decode@tick | poison_request@n | wedge_in_swap@n:replica=k |
@@ -572,6 +624,21 @@ def main(argv):
            "cache_mib": round(cache_bytes / 2 ** 20, 2)}
     out.update({k: (round(v, 6) if isinstance(v, float) else v)
                 for k, v in sched.stats().items()})
+    # the flywheel panel (ISSUE 19): raw per-version acceptance counts
+    # next to the rate keys stats() already rendered — a distilled
+    # draft's roll reads as accept_by_version growing a new version row
+    acc = sched.accept_by_version()
+    if acc:
+        out["accept_by_version"] = {
+            str(v): [p, a] for v, (p, a) in acc.items()}
+    if sink is not None:
+        # commits the open shard to the manifest; anything torn before
+        # this point is recovered by the next sink's orphan adoption
+        sink.close()
+        out["log_sink_dir"] = FLAGS.log_sink_dir
+        out["log_sink"] = sink.stats()
+    if FLAGS.draft_publish_dir:
+        out["draft_publish_dir"] = FLAGS.draft_publish_dir
     if heartbeat is not None:
         # heartbeats + SLO-excursion count + worst compliance fraction:
         # a run that breached and recovered must not look clean
@@ -595,6 +662,21 @@ def main(argv):
         # without this flag, compile_events==0 would be ambiguous between
         # "steady state" and "jax.monitoring unobservable on this jax"
         out["monitoring_available"] = tel.fence.monitoring_available
+        # stamp the serve flight record — acceptance per version rides
+        # the logdir-local TELEMETRY.json next to the flight dumps
+        from dtf_tpu.telemetry.run import merge_artifact
+
+        extra = {"source": "serve_gpt",
+                 "served_version": served_version,
+                 "final_version": out["final_version"]}
+        if acc:
+            extra["accept_by_version"] = {
+                str(v): [p, a] for v, (p, a) in acc.items()}
+        if sink is not None:
+            extra["log_sink"] = sink.stats()
+        merge_artifact(
+            os.path.join(FLAGS.logdir, "telemetry", "TELEMETRY.json"),
+            tel.report(extra))
     print(json.dumps(out))
 
 

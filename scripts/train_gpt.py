@@ -113,6 +113,23 @@ flags.DEFINE_string("stream_spec", "", "streaming data tier (ISSUE 15, "
                     "and a resumed run cannot silently change its "
                     "mixture. Empty: the plain --data_dir/synthetic "
                     "path")
+flags.DEFINE_integer("distill_draft", 0, "acceptance-driven draft "
+                     "refresh (ISSUE 19): train an N-layer EARLY-EXIT "
+                     "draft of the served checkpoint named by "
+                     "--distill_from, initialized from its first N "
+                     "blocks (gpt.draft_truncate) — the served model "
+                     "itself is never touched. Point --stream_spec at a "
+                     "'servelog' source (serve_gpt --log_sink_dir's "
+                     "shards) to distill on live traffic, and "
+                     "--publish_dir at the dir a fleet polls via "
+                     "serve_gpt --draft_publish_dir for draft-only "
+                     "rolling swaps (docs/SERVING.md). 0 = off")
+flags.DEFINE_string("distill_from", "", "with --distill_draft: logdir of "
+                    "the SERVED checkpoint whose manifest fixes the "
+                    "architecture and whose params seed the draft "
+                    "(--size and the architecture flags are ignored — "
+                    "a draft that drifts from the verifier's widths "
+                    "could not swap in)")
 FLAGS = flags.FLAGS
 
 
@@ -163,6 +180,56 @@ def main(argv):
                               matmul_precision=FLAGS.matmul_precision,
                               moe=dataclasses.replace(
                                   base.moe, top_k=FLAGS.moe_top_k))
+    # acceptance-driven draft refresh (ISSUE 19): the architecture comes
+    # from the SERVED manifest truncated to --distill_draft layers — a
+    # draft that drifted from the verifier's widths could not swap in —
+    # and the params seed from its first blocks (the base checkpoint is
+    # read-only here; only the student trains)
+    bman = distill_params = None
+    if FLAGS.distill_draft:
+        if not FLAGS.distill_from:
+            raise app.UsageError(
+                "--distill_draft needs --distill_from=<served logdir> "
+                "(the checkpoint whose first layers seed the draft)")
+        if mesh.shape.get("pipe", 1) > 1:
+            raise app.UsageError(
+                "--distill_draft does not compose with --mesh_pipe: the "
+                "draft is at most served-depth minus one layer — "
+                "depth-sharding it buys nothing")
+        from dtf_tpu.checkpoint import load_model_config as _load_mc
+
+        bdir = os.path.join(FLAGS.distill_from, "ckpt")
+        bman = _load_mc(bdir)
+        if bman is None:
+            raise app.UsageError(
+                f"--distill_from={FLAGS.distill_from} has no "
+                "model_config.json manifest; the served architecture "
+                "cannot be guessed")
+        try:
+            bbase = gpt.GPTConfig.by_name(bman.get("size", "small"))
+        except KeyError as e:
+            raise app.UsageError(
+                f"--distill_from manifest size: {e.args[0]}")
+        bcfg = dataclasses.replace(
+            bbase, kv_heads=bman.get("kv_heads") or None,
+            attn_window=int(bman.get("attn_window", 0) or 0),
+            attn_global_every=int(bman.get("attn_global_every", 0) or 0))
+        bck = Checkpointer(bdir)
+        if bck.latest_step() is None:
+            raise app.UsageError(f"no checkpoint under {bdir}")
+        bparams = bck.restore_params()
+        bck.close()
+        try:
+            cfg, distill_params = gpt.draft_truncate(
+                bcfg, bparams, FLAGS.distill_draft)
+        except ValueError as e:
+            raise app.UsageError(str(e))
+        cfg = dataclasses.replace(cfg, remat=FLAGS.remat,
+                                  attn_impl=FLAGS.attn_impl)
+        absl_logging.info(
+            "distilling a %d-layer draft of %s (size %s, served step %d)",
+            FLAGS.distill_draft, FLAGS.distill_from,
+            bman.get("size", "?"), bck.last_restored_step)
     sched = dflags.make_lr_schedule(FLAGS)   # LoggingHook surfaces the LR
     tx = dflags.make_optimizer(
         FLAGS, lambda s: optax.adamw(s, weight_decay=(
@@ -288,6 +355,13 @@ def main(argv):
     state, shardings = tr.create_train_state(
         init_fn, tx, jax.random.PRNGKey(FLAGS.seed), mesh,
         param_rules=param_rules, zero1=FLAGS.zero1)
+    if distill_params is not None:
+        # seed the student: the state was BUILT at the draft architecture,
+        # so this is a values-only device_put onto the already-computed
+        # shardings — fresh optimizer moments are exactly right for a
+        # newly-initialized student
+        state = state.replace(params=jax.device_put(
+            distill_params, shardings.params))
 
     from dtf_tpu.data import formats
 
@@ -410,6 +484,18 @@ def main(argv):
         "moe_every": FLAGS.moe_every, "vocab_size": cfg.vocab_size,
         "d_model": cfg.d_model, "layers": cfg.layers, "heads": cfg.heads,
         "d_ff": cfg.d_ff, "kv_cache_dtype": ""}
+    if FLAGS.distill_draft:
+        # a DRAFT manifest: size names the base widths, "layers" (already
+        # cfg.layers == the truncation) + "draft_layers" mark the depth —
+        # serve_gpt --draft_ckpt resolves the truncated stack from it
+        manifest_cfg.update({
+            "size": bman.get("size", FLAGS.size),
+            "kv_heads": cfg.kv_heads or 0,
+            "attn_window": cfg.attn_window,
+            "attn_global_every": cfg.attn_global_every,
+            "moe_every": 0,
+            "draft_layers": FLAGS.distill_draft,
+            "distilled_from": FLAGS.distill_from})
     if stream_spec is not None:
         # the mixture identity rides the manifest: the resolve above
         # guarantees a relaunch into this logdir keeps (or is refused a

@@ -205,8 +205,14 @@ def test_shipping_configs_memory_pass_clean(name):
 
 def test_fit_serve_reports_bf16_and_int8_slots():
     out = mem.fit("gpt_serve", hbm_gb=16, max_len=1024, kv_page_size=64,
-                  slots=64)
+                  slots=64, log_sink=True)
     assert out["kind"] == "serve"
+    # the serve-log sink (ISSUE 19) is host-side file IO: the fit row is
+    # an explicit HBM no-op, and train configs reject the flag outright
+    assert out["log_sink"] == {"hbm_delta_bytes": 0,
+                               "host_side_only": True}
+    with pytest.raises(ValueError, match="serve config"):
+        mem.fit("mnist", hbm_gb=1, log_sink=True)
     bf16, int8 = out["kv"]["bf16"], out["kv"]["int8"]
     assert bf16["max_slots"] > 0
     # int8 KV halves cache bytes (scales add ~1/d_head back): strictly
