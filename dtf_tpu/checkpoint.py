@@ -111,6 +111,19 @@ class Checkpointer:
         #: launchers can fold "what did this resume silently drop" into
         #: their run reports instead of grepping logs.
         self.resume_events: list[dict] = []
+        #: optional fleet EventLog (ISSUE 20) — save/restore/fallback
+        #: verdicts land on the run timeline too.
+        self._event_log = None
+
+    def attach_event_log(self, event_log) -> None:
+        """Mirror checkpoint lifecycle (saves queued, guarded-restore
+        fallbacks, degraded resumes) onto a fleet
+        :class:`dtf_tpu.telemetry.events.EventLog`."""
+        self._event_log = event_log
+
+    def _ckpt_event(self, kind: str, /, **fields) -> None:
+        if self._event_log is not None:
+            self._event_log.emit(kind, directory=self.directory, **fields)
 
     @property
     def directory(self) -> str:
@@ -180,18 +193,23 @@ class Checkpointer:
             params = state.get("params")
         if params is None:
             if not extras:
-                return self._mgr.save(
+                queued = self._mgr.save(
                     step, args=ocp.args.StandardSave(state), force=force)
-            return self._mgr.save(
-                step, args=ocp.args.Composite(
-                    state=ocp.args.StandardSave(state), **extras),
+            else:
+                queued = self._mgr.save(
+                    step, args=ocp.args.Composite(
+                        state=ocp.args.StandardSave(state), **extras),
+                    force=force)
+        else:
+            queued = self._mgr.save(
+                step,
+                args=ocp.args.Composite(state=ocp.args.StandardSave(state),
+                                        params=ocp.args.StandardSave(params),
+                                        **extras),
                 force=force)
-        return self._mgr.save(
-            step,
-            args=ocp.args.Composite(state=ocp.args.StandardSave(state),
-                                    params=ocp.args.StandardSave(params),
-                                    **extras),
-            force=force)
+        if queued:
+            self._ckpt_event("ckpt_save", step=step)
+        return queued
 
     def save_params(self, step: int, params: PyTree, *,
                     force: bool = True) -> bool:
@@ -313,6 +331,8 @@ class Checkpointer:
                     type(e).__name__, e,
                     f"step {older}" if older is not None
                     else "nothing — no older step")
+                self._ckpt_event("ckpt_fallback", bad_step=s,
+                                 error=type(e).__name__)
                 continue
             if s != steps[0]:
                 log.warning(
@@ -320,6 +340,7 @@ class Checkpointer:
                     "(unreadable); training will redo the difference", s,
                     steps[0])
             self._last_restored_step = s
+            self._ckpt_event("ckpt_restore", step=s, newest=steps[0])
             return restored
         raise RuntimeError(
             f"every checkpoint step under {self.directory} is unreadable "
@@ -450,6 +471,8 @@ class Checkpointer:
             self.resume_events.append({
                 "event": "missing-extra", "item": name, "step": step,
                 "t": round(self._wall(), 3)})
+            self._ckpt_event("ckpt_resume_degraded", kind="missing-extra",
+                             item=name, step=step)
             return None
         try:
             return self._mgr.restore(
@@ -465,6 +488,8 @@ class Checkpointer:
                 "event": "unreadable-extra", "item": name, "step": step,
                 "error": f"{type(e).__name__}: {str(e)[:200]}",
                 "t": round(self._wall(), 3)})
+            self._ckpt_event("ckpt_resume_degraded", kind="unreadable-extra",
+                             item=name, step=step)
             return None
 
     def restore_if_exists(self, target: PyTree) -> tuple[PyTree, int | None]:

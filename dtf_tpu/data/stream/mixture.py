@@ -108,6 +108,10 @@ class MixtureStream:
         self._stop = threading.Event()
         self._fault = None
         self._fault_fired = False
+        #: optional fleet EventLog (ISSUE 20): reweights and fault
+        #: firings land on the run timeline. EventLog.emit is internally
+        #: locked — the producer thread emits safely.
+        self._event_log = None
         self._stats = {
             "batches": 0, "examples": {n: 0 for n in names},
             "produce_s": 0.0, "producer_blocked_s": 0.0,
@@ -161,6 +165,18 @@ class MixtureStream:
             self._schedule = schedule
             log.info("mixture reweighted at step %d: %s", at_step,
                      {n: round(w, 4) for n, w in norm.items()})
+        if self._event_log is not None:
+            self._event_log.emit(
+                "stream_reweight", at_step=int(at_step),
+                weights={n: round(w, 6) for n, w in norm.items()})
+
+    def attach_event_log(self, event_log) -> None:
+        """Mirror stream lifecycle (reweights, chaos-verb firings) onto a
+        fleet :class:`dtf_tpu.telemetry.events.EventLog`. The producer
+        thread reads the reference, so the publish takes the class lock
+        (the EventLog itself is internally locked)."""
+        with self._lock:
+            self._event_log = event_log
 
     # ------------------------------------------------------------ the draws
 
@@ -237,6 +253,9 @@ class MixtureStream:
                     stall_for = self.stall_s
         if fired is not None:
             src = self.sources[fired.source or 0]
+            if self._event_log is not None:
+                self._event_log.emit("stream_fault", kind=fired.kind,
+                                     source=src.name, step=int(step))
             if fired.kind == "stall_source":
                 log.warning(
                     "stream fault: stalling source %r for %.1fs at step "

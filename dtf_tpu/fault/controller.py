@@ -181,7 +181,8 @@ class RunController:
                  heartbeat_path: Optional[Callable[[int], str]] = None,
                  valid_hosts: Optional[Callable[[int], bool]] = None,
                  emit: Callable[[str], None] = None,
-                 clock=time.monotonic, wall=time.time, sleep=time.sleep):
+                 clock=time.monotonic, wall=time.time, sleep=time.sleep,
+                 event_log=None):
         if n_hosts < 1:
             raise ValueError(f"n_hosts must be >= 1, got {n_hosts}")
         self.launch = launch
@@ -196,6 +197,10 @@ class RunController:
         self.wall = wall
         self.sleep = sleep
         self.events: list[dict] = []
+        #: optional fleet EventLog (ISSUE 20): every verdict the
+        #: controller emits is mirrored onto the run timeline with the
+        #: controller's OWN wall stamp (MTTR ground truth).
+        self.event_log = event_log
         self.mttr_s: list[float] = []
         self.restarts = 0
         self.causes: list[str] = []
@@ -234,6 +239,14 @@ class RunController:
                         line)
         except OSError:
             pass
+        if self.event_log is not None:
+            fields = {k: v for k, v in rec.items()
+                      if k not in ("controller", "t", "state", "hosts")}
+            # "hosts" is the bulky per-host observation dump — it stays
+            # in controller.jsonl; the timeline carries the verdict
+            state = event.get("state", rec.get("controller", "event"))
+            self.event_log.emit(f"controller_{state}", t=rec["t"],
+                                **fields)
         return rec
 
     def _observe(self, procs: Sequence,
@@ -398,7 +411,17 @@ class RunController:
                meta: Optional[Mapping] = None) -> Optional[dict]:
         """Stamp the run's MTTR/restart fields into TELEMETRY.json
         (``telemetry.run.merge_artifact`` — jax-free, same bounded-runs
-        layout the RunReports use)."""
+        layout the RunReports use). Always emits the terminal ``run_end``
+        event FIRST (ISSUE 20 satellite): the timeline must close every
+        episode even when the artifact merge is skipped."""
+        if self.event_log is not None:
+            self.event_log.emit(
+                "run_end", final=summary.get("final", "unknown"),
+                restarts=int(summary.get("restarts", self.restarts)),
+                causes=list(summary.get("causes", self.causes)),
+                mttr_s=list(summary.get("mttr_s", self.mttr_s)),
+                t=round(self.wall(), 3))
+            self.event_log.flush()   # commit: the timeline reads it now
         if not telemetry_artifact:
             return None
         from dtf_tpu.telemetry.run import merge_artifact

@@ -64,9 +64,14 @@ KINDS = ("kill", "wedge", "sigterm", "sigterm_in_save", "crash",
 #: WARN, exactly the bit-rot branch; ``crash_in_log_rotate@N`` raises
 #: after the N-th rotation's shard is durable but BEFORE its manifest
 #: commit — every committed record must survive via shard adoption.
+#: The event-plane verb (ISSUE 20): ``crash_in_event_rotate@N`` is the
+#: same crash seam on the fleet EventLog (dtf_tpu/telemetry/events.py) —
+#: the next mount must ADOPT the orphaned event shard and the timeline
+#: must still close every episode.
 SERVE_KINDS = ("wedge_replica", "slow_decode", "poison_request",
                "poison_draft", "corrupt_publish", "wedge_in_swap",
-               "corrupt_log_record", "crash_in_log_rotate")
+               "corrupt_log_record", "crash_in_log_rotate",
+               "crash_in_event_rotate")
 
 #: the STREAMING-DATA-TIER verbs (ISSUE 15) — same env var, same grammar,
 #: targeting the mixture stream's producer (dtf_tpu/data/stream) instead

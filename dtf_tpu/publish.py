@@ -129,6 +129,9 @@ class ParamPublisher:
         #: manifest must keep serving).
         self._pre_commit = None
         self.published = 0
+        #: optional fleet EventLog (ISSUE 20) — each committed publish
+        #: lands on the run timeline.
+        self.event_log = None
 
     @property
     def checkpointer(self) -> Checkpointer:
@@ -170,6 +173,11 @@ class ParamPublisher:
         atomic_replace(path, json.dumps(manifest, indent=1,
                                         sort_keys=True) + "\n")
         self.published += 1
+        if self.event_log is not None:
+            # after the manifest rename: only COMMITTED versions reach
+            # the timeline (a crashed attempt never published anything)
+            self.event_log.emit("publish_version", version=version,
+                                step=int(step), digest=digest)
         log.info("published params version %d (train step %d) to %s",
                  version, step, self.directory)
         return version

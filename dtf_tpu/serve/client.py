@@ -78,18 +78,22 @@ class Heartbeat:
     the scheduler/router ``stats()`` panel: per-replica occupancy, TTFT
     p50/p99, and the SLO compliance fraction. When ``slo_floor > 0`` and
     the ok-fraction drops below it, a WARNING logs once per excursion
-    (re-armed when compliance recovers — a sustained breach must not spam
-    one warning per tick); every excursion is COUNTED and the worst
-    ok-fraction retained, so :meth:`stats` can stamp both into the
+    EPISODE — per key: the fleet aggregate and each ``replica{i}`` panel
+    dedup independently, re-armed when that key's compliance recovers (a
+    sustained breach, or one breach seen through several replicas, must
+    not spam one warning per tick); every excursion is COUNTED and the
+    worst ok-fraction retained, so :meth:`stats` can stamp both into the
     launcher's final JSON line (a run that breached and recovered is not
-    allowed to look clean). With a ``flight`` recorder attached, each
+    allowed to look clean). With an ``events`` log attached, each episode
+    lands on the run timeline as paired ``slo_excursion`` enter/exit
+    records carrying their entry/exit ticks. With a ``flight`` recorder attached, each
     emit also writes the atomic liveness heartbeat file with a ``serve``
     summary — the PR 11 run-controller surface, serving edition. Host
     arithmetic only; stats() is already readback-free.
     """
 
     def __init__(self, sched, *, every_ticks: int, slo_floor: float = 0.0,
-                 emit=None, clock=time.monotonic, flight=None):
+                 emit=None, clock=time.monotonic, flight=None, events=None):
         if every_ticks < 1:
             raise ValueError(f"every_ticks={every_ticks} must be >= 1")
         self.sched = sched
@@ -98,12 +102,20 @@ class Heartbeat:
         self.emit = emit or (lambda line: print(line, file=sys.stderr))
         self.clock = clock
         self.flight = flight
+        #: optional fleet EventLog (ISSUE 20): excursion entry/exit edges
+        #: land on the run timeline with their ticks
+        self.events = events
         self._t0 = clock()
         self._ticks = 0
         self.emitted = 0
         self.excursions = 0
+        self.replica_excursions = 0
         self.worst_ok_frac: float | None = None
-        self._below_floor = False
+        #: open excursion episodes, keyed "fleet" / "replica{i}" — entry
+        #: is the ONE moment that WARNs and emits (a sustained breach, or
+        #: the same breach seen through several replicas' panels, must
+        #: not spam); exit closes the episode on the event plane.
+        self._episodes: dict = {}
 
     def snapshot(self) -> dict:
         stats = self.sched.stats()
@@ -136,18 +148,46 @@ class Heartbeat:
         if ok is not None:
             self.worst_ok_frac = (ok if self.worst_ok_frac is None
                                   else min(self.worst_ok_frac, ok))
-        if self.slo_floor > 0.0 and ok is not None:
-            if ok < self.slo_floor and not self._below_floor:
-                self._below_floor = True
-                self.excursions += 1
-                log.warning(
-                    "TTFT SLO compliance %.3f below the %.3f floor "
-                    "(p99 %.4fs; excursion %d)", ok, self.slo_floor,
-                    snap.get("router_ttft_p99_s",
-                             snap.get("serve_ttft_p99_s", 0.0)),
-                    self.excursions)
-            elif ok >= self.slo_floor:
-                self._below_floor = False
+        if self.slo_floor > 0.0:
+            fracs = {}
+            if ok is not None:
+                fracs["fleet"] = ok
+            suffix = "_serve_ttft_slo_ok_frac"
+            for k, v in snap.items():
+                if k.startswith("replica") and k.endswith(suffix):
+                    fracs[k[:-len(suffix)]] = v
+            for key, frac in fracs.items():
+                ep = self._episodes.get(key)
+                if frac < self.slo_floor and ep is None:
+                    self._episodes[key] = {"tick": self._ticks,
+                                           "ok": frac}
+                    if key == "fleet":
+                        self.excursions += 1
+                        log.warning(
+                            "TTFT SLO compliance %.3f below the %.3f "
+                            "floor (p99 %.4fs; excursion %d)", frac,
+                            self.slo_floor,
+                            snap.get("router_ttft_p99_s",
+                                     snap.get("serve_ttft_p99_s", 0.0)),
+                            self.excursions)
+                    else:
+                        self.replica_excursions += 1
+                        log.warning(
+                            "%s TTFT SLO compliance %.3f below the %.3f "
+                            "floor (one WARN per replica episode)",
+                            key, frac, self.slo_floor)
+                    if self.events is not None:
+                        self.events.emit(
+                            "slo_excursion", edge="enter", key=key,
+                            ok_frac=round(frac, 6), tick=self._ticks)
+                elif frac >= self.slo_floor and ep is not None:
+                    del self._episodes[key]
+                    if self.events is not None:
+                        self.events.emit(
+                            "slo_excursion", edge="exit", key=key,
+                            ok_frac=round(frac, 6), tick=self._ticks,
+                            entered_tick=ep["tick"],
+                            ticks=self._ticks - ep["tick"])
         if self.flight is not None:
             # the run-controller liveness surface: the heartbeat file a
             # chief-side watcher polls, with the serve panel riding along
@@ -171,7 +211,8 @@ class Heartbeat:
         how often compliance dipped below the floor and how bad the worst
         dip was (a breach-and-recover run must not look clean)."""
         out = {"heartbeats": float(self.emitted),
-               "slo_excursions": float(self.excursions)}
+               "slo_excursions": float(self.excursions),
+               "replica_slo_excursions": float(self.replica_excursions)}
         if self.worst_ok_frac is not None:
             out["worst_ttft_slo_ok_frac"] = round(self.worst_ok_frac, 6)
         return out

@@ -129,11 +129,14 @@ class HealthTracker:
     """
 
     def __init__(self, n_replicas: int, cfg: Optional[HealthConfig] = None,
-                 *, clock=time.monotonic):
+                 *, clock=time.monotonic, events=None):
         if n_replicas < 1:
             raise ValueError(f"n_replicas={n_replicas} must be >= 1")
         self.cfg = cfg or HealthConfig()
         self.clock = clock
+        #: optional fleet EventLog (dtf_tpu/telemetry/events.py) — every
+        #: transition verdict lands on the run timeline too.
+        self.events = events
         self._r = [
             _Replica(delay_s=self.cfg.probation_delay_s,
                      durations=collections.deque(maxlen=self.cfg.keep))
@@ -222,6 +225,14 @@ class HealthTracker:
         h.last_cause = cause
         self.transitions.append({"replica": i, "from": old, "to": state,
                                  "cause": cause, "t": round(h.since, 3)})
+        if self.events is not None:
+            # "at" = the tracker's own (injectable) clock: episode
+            # durations on the timeline are deltas in THIS domain, while
+            # the sink's wall "t" keeps the record ordered against the
+            # other subsystems' events
+            self.events.emit("health_transition", replica=i, state_from=old,
+                             state_to=state, cause=cause,
+                             at=round(h.since, 6))
         log.warning("serve replica %d: %s -> %s (%s)", i, old, state, cause)
         return state
 
@@ -317,6 +328,11 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
       raises after the shard is durable but BEFORE its manifest commit:
       the next sink over the directory must ADOPT the orphan shard —
       committed records are never lost. No-op without a sink.
+    - ``crash_in_event_rotate@N`` — the same crash seam on the pump's
+      fleet :class:`~dtf_tpu.telemetry.events.EventLog` (``pump.events``):
+      the next event log over the directory must adopt the orphan event
+      shard and the timeline must still close every episode. No-op
+      without an event log.
 
     Ticks are counted in the TARGET's own call domain (decode calls /
     submits) so plans stay deterministic under Poisson timing. ``sleep``
@@ -471,6 +487,18 @@ def install_serve_fault(plan: ServeFaultPlan, pump, *, sleep=time.sleep,
                 sink.arm_corrupt(plan.tick, note=mark)
             else:
                 sink.arm_crash_rotate(plan.tick, note=mark)
+        return state
+
+    if plan.kind == "crash_in_event_rotate":
+        # the fleet event log's crash seam (ISSUE 20) — same shape as the
+        # sink verbs, armed on the pump-shared EventLog
+        events = getattr(pump, "events", None)
+        if events is not None:
+            def mark_ev(what: str) -> None:
+                state.fired = True
+                note(what)
+
+            events.arm_crash_rotate(plan.tick, note=mark_ev)
         return state
 
     delay = (wedge_s if wedge_s is not None

@@ -58,9 +58,14 @@ class LogSink:
     pump is one thread, and records carry their replica id.
     """
 
-    def __init__(self, sink_dir: str, *, rotate_bytes: int = 1 << 20):
+    def __init__(self, sink_dir: str, *, rotate_bytes: int = 1 << 20,
+                 events=None):
         self.dir = os.fspath(sink_dir)
         self.rotate_bytes = int(rotate_bytes)
+        #: optional fleet EventLog (ISSUE 20): rotations and orphan
+        #: adoptions land on the run timeline (set BEFORE adoption so a
+        #: recovery at mount is itself on the record).
+        self.events = events
         manifest = read_manifest(self.dir)
         self._shards: list = list(manifest["shards"]) if manifest else []
         self._adopted = self._adopt_orphans()
@@ -105,6 +110,8 @@ class LogSink:
                 "— a previous sink crashed between the shard write and "
                 "its manifest commit; committed records are never lost",
                 self.dir, name, n)
+            if self.events is not None:
+                self.events.emit("logsink_adopt", shard=name, records=n)
         if adopted:
             self._shards.sort(key=lambda s: s["name"])
             self._commit_manifest()
@@ -170,6 +177,12 @@ class LogSink:
                 f"{self._shards[-1]['name']} (the shard is durable; the "
                 "manifest commit never ran — adoption must recover it)")
         self._commit_manifest()
+        if self.events is not None:
+            # emit only AFTER the manifest commit — a crashed rotation
+            # must not appear on the timeline as a committed one
+            self.events.emit("logsink_rotate",
+                             shard=self._shards[-1]["name"],
+                             records=self._shards[-1]["records"])
 
     def _commit_manifest(self) -> None:
         atomic_replace(manifest_path(self.dir), json.dumps({

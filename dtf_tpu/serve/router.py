@@ -67,6 +67,7 @@ from dtf_tpu.serve import health as health_lib
 from dtf_tpu.serve.engine import DecodeEngine
 from dtf_tpu.serve.scheduler import (FAILED_STATUSES, Request,
                                      RequestFailed, Scheduler)
+from dtf_tpu.telemetry.spans import SpanRecorder
 
 log = logging.getLogger("dtf_tpu")
 
@@ -117,10 +118,14 @@ class Router:
     scheduler knobs apply to every replica's scheduler uniformly.
     """
 
+    #: router ticks between periodic ``cp_profile`` events on the event
+    #: plane (the tick profiler's durable rollup; stats() is the live one).
+    CP_PROFILE_EVERY = 256
+
     def __init__(self, engines: Sequence[DecodeEngine], writer=None, *,
                  telemetry=None, ttft_slo_s: float = 0.0,
                  clock=time.monotonic, health=None,
-                 prefill_replicas: int = 0, log_sink=None,
+                 prefill_replicas: int = 0, log_sink=None, events=None,
                  **scheduler_kw):
         if not engines:
             raise ValueError("Router needs at least one engine replica")
@@ -154,6 +159,15 @@ class Router:
         #: one thread, records carry their replica id, and a single shard
         #: sequence keeps the mounted stream source's addressing global.
         self.log_sink = log_sink
+        #: ONE fleet EventLog (ISSUE 20, dtf_tpu/telemetry/events.py):
+        #: requeue drains, swap lifecycle and health transitions land on
+        #: the run timeline, each stamped with the router tick.
+        self.events = events
+        #: the CONTROL-PLANE TICK PROFILER (ISSUE 20): per-tick phase
+        #: attribution on the PR 5 span machinery, timed on the router's
+        #: own injectable clock — host arithmetic only, zero added device
+        #: readbacks (counter-proven in tests/test_events.py).
+        self._cp = SpanRecorder(clock=clock)
         self.schedulers = [
             Scheduler(e, writer, telemetry=telemetry,
                       ttft_slo_s=ttft_slo_s, clock=clock,
@@ -168,13 +182,16 @@ class Router:
             self.health: Optional[health_lib.HealthTracker] = None
         elif isinstance(health, health_lib.HealthTracker):
             self.health = health
+            if events is not None and health.events is None:
+                health.events = events   # one timeline for the fleet
         elif isinstance(health, health_lib.HealthConfig):
             self.health = health_lib.HealthTracker(
-                len(engines), health, clock=clock)
+                len(engines), health, clock=clock, events=events)
         elif health is None and len(engines) == 1:
             self.health = None
         else:    # None with a fleet, or True
-            self.health = health_lib.HealthTracker(len(engines), clock=clock)
+            self.health = health_lib.HealthTracker(len(engines), clock=clock,
+                                                   events=events)
         if telemetry is not None:
             # ONE aggregate postmortem provider for the fleet (each
             # replica's provider would collide on the name): in-flight
@@ -271,6 +288,13 @@ class Router:
 
     # ------------------------------------------------------------ admission
 
+    def _emit(self, kind: str, /, **fields) -> None:
+        """One fleet event, stamped with the router tick (the pump's own
+        causal counter — the timeline can line events up with the tick
+        profiler even when the wall clock is injected)."""
+        if self.events is not None:
+            self.events.emit(kind, tick=self._ticks, **fields)
+
     def _routable(self, i: int) -> bool:
         if i == self._swapping:     # mid-drain/swap: not a candidate
             return False
@@ -291,17 +315,25 @@ class Router:
         prefill on decode replicas; it never stops the fleet). None when
         nothing at all is routable — the caller sheds at the front
         door."""
-        cands = [i for i in range(len(self.schedulers)) if self._routable(i)]
-        if not cands:
-            return None
-        if self._prefill_replicas:
-            role = [i for i in cands if self._roles[i] == phase]
-            cands = role or cands
-        rank = (self.health.rank if self.health is not None
-                else (lambda i: 0))
-        return min(cands,
-                   key=lambda i: (rank(i), self.schedulers[i].occupancy,
-                                  self.schedulers[i].queue_depth, i))
+        # cp_pick attributes EVERY admission decision (submit, handoff
+        # promotion, requeue) — it may nest inside cp_page_ops; the
+        # phases are attributions, not a partition
+        t0 = self.clock()
+        try:
+            cands = [i for i in range(len(self.schedulers))
+                     if self._routable(i)]
+            if not cands:
+                return None
+            if self._prefill_replicas:
+                role = [i for i in cands if self._roles[i] == phase]
+                cands = role or cands
+            rank = (self.health.rank if self.health is not None
+                    else (lambda i: 0))
+            return min(cands,
+                       key=lambda i: (rank(i), self.schedulers[i].occupancy,
+                                      self.schedulers[i].queue_depth, i))
+        finally:
+            self._cp.add("cp_pick", self.clock() - t0)
 
     def _wants_prefill_replica(self, req: Request) -> bool:
         """Phase classification: a request is PREFILL-HEAVY when at least
@@ -464,6 +496,7 @@ class Router:
         stream, so completed tokens are bitwise identical to a
         fault-free run. With no routable survivor the request sheds at
         the front door."""
+        moved = shed = 0
         for rec in self.schedulers[i].evict_for_requeue():
             rid = rec.trace_id     # the fleet-global id (we threaded it)
             # a drained prefill JOB stays in its phase: re-route it to a
@@ -474,11 +507,16 @@ class Router:
             if j is None:
                 self._handoff.pop(rid, None)
                 self._shed_at_door(rid)
+                shed += 1
                 continue
             local = self.schedulers[j].submit(
                 rec.req, trace_id=rid, submit_t=rec.submit_t, requeued=True)
             self._where[rid] = (j, local)
             self._requeued += 1
+            moved += 1
+        if moved or shed:
+            self._emit("requeue_drain", replica=i, requeued=moved,
+                       shed=shed)
 
     def _probe(self, i: int) -> None:
         """Exercise an idle probation replica with one timed decode probe
@@ -572,6 +610,9 @@ class Router:
                              for s in self.schedulers],
             "watcher": None,
         }
+        self._emit("swap_start", version=version, canary=order[0],
+                   canary_ticks=cfg.canary_ticks,
+                   draft=draft_params is not None)
         log.info("rolling swap to param version %d started (canary "
                  "replica %d, %d-tick window)", version, order[0],
                  cfg.canary_ticks)
@@ -711,6 +752,9 @@ class Router:
                 def mark_canary():
                     sw["canary_swapped"] = True
                     sw["ttft_mark"] = self.schedulers[i].ttft_count
+                    self._emit("swap_canary", version=sw["version"],
+                               replica=i,
+                               canary_ticks=sw["cfg"].canary_ticks)
 
                 self._swap_replica(i, sw["params"], sw["draft"],
                                    sw["version"], mark=mark_canary)
@@ -804,6 +848,8 @@ class Router:
         self._swap_rollbacks += 1
         self._last_swap = {"version": sw["version"],
                            "outcome": "rolled_back", "cause": cause}
+        self._emit("swap_rollback", version=sw["version"], cause=cause,
+                   swapped=len(swapped))
         if sw["watcher"] is not None:
             # a rolled-back version must not immediately re-swap on the
             # next poll: only a NEWER republish may try again (a draft
@@ -822,6 +868,8 @@ class Router:
             sw["watcher"].note_applied(sw.get("watcher_version",
                                               sw["version"]))
         self._invalidate_stale_pages()
+        self._emit("swap_commit", version=sw["version"],
+                   draft=sw["draft"] is not None)
         log.info("rolling swap complete: fleet serving param version %d",
                  sw["version"])
 
@@ -897,7 +945,16 @@ class Router:
         drains that replica onto survivors, so the pump loop never calls
         into a wedged engine again."""
         self._ticks += 1
+        # the control-plane tick profiler (ISSUE 20): cp_engine_tick sums
+        # the replica s.tick() calls (for the health branch, the SAME
+        # wall-time samples the watchdog judges); cp_health_sweep is the
+        # replica loop's remainder (routable checks, verdicts, probes);
+        # cp_page_ops = handoff promotion; cp_bookkeeping = swap machine +
+        # skew tripwire. Host clock arithmetic only.
+        cp = self._cp
         h = self.health
+        t_loop0 = self.clock()
+        engine_s = 0.0
         if h is None:
             for i, s in enumerate(self.schedulers):
                 if i in self._version_repair:
@@ -908,40 +965,55 @@ class Router:
                         self._retry_version_repair(i)
                     continue
                 if s.pending:
+                    t0 = self.clock()
                     s.tick()
-            self._promote_handoffs()
-            self._advance_swap()
-            self._skew_check()
-            return
-        for i, s in enumerate(self.schedulers):
-            if i in self._version_repair:
-                # stuck on a rolled-back version: the repair must land
-                # before the health machine may re-admit it (routable()
-                # flips quarantine→probation lazily — let it, but no
-                # probe/traffic this tick either way)
-                if h.routable(i):
-                    self._retry_version_repair(i)
-                continue
-            if not h.routable(i):
-                continue
-            if not s.pending:
-                if h.state(i) == health_lib.PROBATION:
-                    self._probe(i)
-                continue
-            t0 = self.clock()
-            try:
-                s.tick()
-            except Exception as e:  # noqa: BLE001 — a decode-path engine
-                # failure has no single owning request: quarantine the
-                # replica and replay its in-flight work on survivors
-                h.note_fault(i, e)
-                self._requeue_from(i)
-                continue
-            if h.note_tick(i, self.clock() - t0) == health_lib.QUARANTINED:
-                self._requeue_from(i)
+                    engine_s += self.clock() - t0
+        else:
+            for i, s in enumerate(self.schedulers):
+                if i in self._version_repair:
+                    # stuck on a rolled-back version: the repair must land
+                    # before the health machine may re-admit it (routable()
+                    # flips quarantine→probation lazily — let it, but no
+                    # probe/traffic this tick either way)
+                    if h.routable(i):
+                        self._retry_version_repair(i)
+                    continue
+                if not h.routable(i):
+                    continue
+                if not s.pending:
+                    if h.state(i) == health_lib.PROBATION:
+                        self._probe(i)
+                    continue
+                t0 = self.clock()
+                try:
+                    s.tick()
+                except Exception as e:  # noqa: BLE001 — a decode-path
+                    # engine failure has no single owning request:
+                    # quarantine the replica and replay its in-flight
+                    # work on survivors
+                    engine_s += self.clock() - t0
+                    h.note_fault(i, e)
+                    self._requeue_from(i)
+                    continue
+                dur = self.clock() - t0
+                engine_s += dur
+                if h.note_tick(i, dur) == health_lib.QUARANTINED:
+                    self._requeue_from(i)
+        t_loop1 = self.clock()
+        cp.add("cp_engine_tick", engine_s)
+        cp.add("cp_health_sweep", max(0.0, (t_loop1 - t_loop0) - engine_s))
+        t0 = self.clock()
         self._promote_handoffs()
+        t1 = self.clock()
+        cp.add("cp_page_ops", t1 - t0)
         self._advance_swap()
         self._skew_check()
+        cp.add("cp_bookkeeping", self.clock() - t1)
+        if self.events is not None and self._ticks % self.CP_PROFILE_EVERY == 0:
+            self._emit("cp_profile", **{
+                f"{name}_total_s": round(cp.total(name), 6)
+                for name in ("cp_pick", "cp_engine_tick", "cp_health_sweep",
+                             "cp_page_ops", "cp_bookkeeping")})
 
     def run_until_idle(self, max_ticks: int = 100000, *,
                        on_tick=None) -> None:
@@ -1080,6 +1152,16 @@ class Router:
             for k, v in getattr(s.engine, "counters", {}).items():
                 counters[k] = counters.get(k, 0) + v
         out.update({f"router_{k}": float(v) for k, v in counters.items()})
+        # the control-plane tick profiler panel (ISSUE 20): where the
+        # pump's host time goes, per phase — the live view of what
+        # bench_serve_cp fences and the cp_profile events make durable
+        out["router_ticks"] = float(self._ticks)
+        for name, roll in self._cp.rollup().items():
+            out[f"{name}_total_s"] = roll["total_s"]
+            out[f"{name}_mean_s"] = roll["mean_s"]
+            out[f"{name}_p99_s"] = roll["p99_s"]
+        if self.events is not None:
+            out["router_events"] = float(self.events.stats()["events"])
         for i, s in enumerate(self.schedulers):
             st = s.stats()
             for k in _REPLICA_KEYS:

@@ -15,6 +15,7 @@ from dtf_tpu.telemetry.accounting import (GoodputTracker,          # noqa: F401
                                           analytic_lm_flops_per_step,
                                           cost_analysis_flops,
                                           param_count)
+from dtf_tpu.telemetry.events import EventLog, read_events         # noqa: F401
 from dtf_tpu.telemetry.fence import CompileFence                   # noqa: F401
 from dtf_tpu.telemetry.flight import (FlightRecorder,              # noqa: F401
                                       StallWatchdog)

@@ -1,9 +1,17 @@
-"""``python -m dtf_tpu.telemetry report`` — device-profile analytics, ONE
+"""``python -m dtf_tpu.telemetry report|timeline`` — run analytics, ONE
 JSON line (bench.py idiom: stdout's last line is always one JSON object).
 
     python -m dtf_tpu.telemetry report --logdir=/tmp/run/profile
     python -m dtf_tpu.telemetry report --logdir=... --hlo=step.hlo.txt \
         --flops=1.2e12 --peak=1.97e14 --n-devices=8 --chrome=trace.json
+    python -m dtf_tpu.telemetry timeline --logdir=/tmp/run \
+        [--events-dir=...] [--chrome=timeline.trace.json]
+
+``timeline`` merges the fleet event plane with controller.jsonl,
+heartbeat liveness files and postmortem dumps into one causally-ordered
+run story + a derived SLO report (MTTR, swap/quarantine/excursion
+episodes) — see :mod:`dtf_tpu.telemetry.timeline`. Deterministic: the
+same logdir bytes yield a byte-identical report and chrome trace.
 
 Parses the newest XPlane session under ``--logdir`` into per-category
 device-time buckets, per-collective ``file:line`` provenance rows (when
@@ -106,7 +114,30 @@ def main(argv: list[str] | None = None) -> int:
     rep.add_argument("--peak", type=float, default=None,
                      help="per-chip peak FLOP/s (default: v5e bf16)")
     rep.add_argument("--n-devices", type=int, default=1)
+    tl = sub.add_parser("timeline", help="merge a run's host-side trails "
+                        "into one ordered timeline + SLO report")
+    tl.add_argument("--logdir", required=True,
+                    help="the run's logdir (holds controller.jsonl, "
+                         "telemetry/, and/or the event plane)")
+    tl.add_argument("--events-dir", default="",
+                    help="event-plane directory when it is not the logdir "
+                         "or <logdir>/events")
+    tl.add_argument("--chrome", default="",
+                    help="also write a Perfetto chrome-trace JSON here")
     args = p.parse_args(argv)
+    if args.cmd == "timeline":
+        from dtf_tpu.telemetry.timeline import build_timeline
+
+        try:
+            report = build_timeline(args.logdir,
+                                    events_dir=args.events_dir or None,
+                                    chrome=args.chrome)
+        except Exception as e:  # noqa: BLE001 — one JSON line no matter what
+            print(json.dumps({"telemetry": "timeline",
+                              "error": f"{type(e).__name__}: {e}"}))
+            return 2
+        print(json.dumps(report, sort_keys=True))
+        return 0
     if args.peak is None and args.flops is not None:
         from dtf_tpu.telemetry.accounting import V5E_PEAK_BF16_FLOPS
 

@@ -1,0 +1,377 @@
+"""One causally-ordered run timeline + derived SLO report (ISSUE 20).
+
+``python -m dtf_tpu.telemetry timeline --logdir=...`` merges every
+host-side trail a run leaves behind into ONE ordered entry list:
+
+- the fleet EVENT PLANE (``events-*.jsonl`` + ``EVENTS_MANIFEST.json``,
+  :mod:`dtf_tpu.telemetry.events`) — train hooks, checkpoint saves and
+  degraded restores, publish versions, serve health transitions, requeue
+  drains, swap lifecycle, stream reweights/faults, sink rotations, SLO
+  excursions, controller verdicts mirrored with their own wall stamps;
+- ``controller.jsonl`` — the fault controller's full per-transition
+  record (including the bulky per-host observation dumps the mirrored
+  events drop);
+- flight-recorder liveness files (``telemetry/heartbeat.json`` and the
+  multi-host ``telemetry/p*/heartbeat.json``) — each is a LAST-snapshot
+  (atomic replace), so it contributes one entry: the run's final
+  liveness observation per host;
+- postmortem dumps (``telemetry/postmortem.json`` + per-host variants) —
+  the reason/step/pid of every crash-context dump (the step-record ring
+  stays in the file; the timeline carries the verdict).
+
+Ordering is ``(t, seq)`` with a stable sort — ``seq`` is the event
+plane's per-writer emit counter, the causal tiebreak when wall stamps
+collide. Event records that carry a second clock domain (the health
+tracker's injectable ``at``, the Router's ``tick``) keep it as a field:
+DURATIONS in the derived report are deltas in the emitter's own clock
+domain (the injectable-clock ground truth), while ``t`` only orders the
+merged stream.
+
+Everything here is pure host-side file parsing — no backend, no jax
+import, deterministic: the same logdir bytes produce a byte-identical
+report and chrome trace (sorted keys, no generation timestamps).
+docs/OBSERVABILITY.md §9 documents the schema and the workflow.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from dtf_tpu._hostio import atomic_replace
+from dtf_tpu.telemetry.events import (EVENTS_MANIFEST_BASENAME,
+                                      _on_disk_shards, read_events)
+
+#: postmortem fields dropped from timeline entries — the step-record
+#: ring and scalar panel stay in the dump file; the timeline is a spine.
+_POSTMORTEM_BULK = ("records", "last_scalars", "context")
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    """Nearest-rank percentile over a small host-side sample (the
+    SpanRecorder rollup convention — no numpy dependency here)."""
+    s = sorted(xs)
+    return s[min(len(s) - 1, int(round(q * (len(s) - 1))))]
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    """Every parseable JSON line of ``path`` (order preserved); a torn
+    tail line or a missing file reads as fewer records, never an error."""
+    try:
+        with open(path) as f:
+            raw = f.read()
+    except OSError:
+        return []
+    out = []
+    for line in raw.split("\n"):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def resolve_events_dir(logdir: str) -> Optional[str]:
+    """Find the run's event-plane directory: the logdir itself, or the
+    conventional ``<logdir>/events`` child (what the launchers default
+    ``--event_log_dir`` to). None = the run kept no event plane."""
+    for cand in (logdir, os.path.join(logdir, "events")):
+        if (os.path.exists(os.path.join(cand, EVENTS_MANIFEST_BASENAME))
+                or _on_disk_shards(cand)):
+            return cand
+    return None
+
+
+def collect_entries(logdir: str, *,
+                    events_dir: Optional[str] = None) -> List[dict]:
+    """The merged, causally-ordered entry list (module docstring). Each
+    entry is ``{"t", "source", "kind", **fields}``; sources are
+    ``events`` / ``controller`` / ``heartbeat`` / ``postmortem``."""
+    entries: List[dict] = []
+    ev_dir = events_dir or resolve_events_dir(logdir)
+    if ev_dir is not None:
+        for rec in read_events(ev_dir):
+            e = {"t": float(rec.get("t", 0.0)), "source": "events",
+                 "kind": str(rec.get("event", "unknown"))}
+            e.update({k: v for k, v in rec.items() if k not in ("event",)})
+            entries.append(e)
+    for rec in _read_jsonl(os.path.join(logdir, "controller.jsonl")):
+        kind = rec.get("state", rec.get("controller", "event"))
+        e = {"t": float(rec.get("t", 0.0)), "source": "controller",
+             "kind": f"controller_{kind}"}
+        e.update({k: v for k, v in rec.items()
+                  if k not in ("controller", "t", "state")})
+        entries.append(e)
+    tel = os.path.join(logdir, "telemetry")
+    hb_paths = sorted(glob.glob(os.path.join(tel, "heartbeat.json"))
+                      + glob.glob(os.path.join(tel, "p*", "heartbeat.json")))
+    for path in hb_paths:
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        host = os.path.basename(os.path.dirname(path))
+        e = {"t": float(rec.get("t", 0.0)), "source": "heartbeat",
+             "kind": "heartbeat",
+             "host": host if host.startswith("p") else "p0"}
+        e.update({k: v for k, v in rec.items() if k != "t"})
+        entries.append(e)
+    pm_paths = sorted(glob.glob(os.path.join(tel, "postmortem.json"))
+                      + glob.glob(os.path.join(tel, "p*", "postmortem.json")))
+    for path in pm_paths:
+        host = os.path.basename(os.path.dirname(path))
+        for rec in _read_jsonl(path):
+            e = {"t": float(rec.get("t", 0.0)), "source": "postmortem",
+                 "kind": f"postmortem_{rec.get('reason', 'unknown')}",
+                 "host": host if host.startswith("p") else "p0"}
+            e.update({k: v for k, v in rec.items()
+                      if k not in ("telemetry", "t", "reason")
+                      and k not in _POSTMORTEM_BULK})
+            entries.append(e)
+    # stable sort: collection order above is itself deterministic
+    # (manifest order, then sorted shard/file names), so ties beyond
+    # (t, seq) keep a reproducible order — byte-identical reports.
+    entries.sort(key=lambda e: (e["t"], e.get("seq", -1)))
+    return entries
+
+
+# --------------------------------------------------------------- episodes
+
+def _swap_episodes(ev: List[dict]) -> Tuple[List[dict], int]:
+    """Pair ``swap_start`` with its ``swap_commit``/``swap_rollback``
+    (per version; the Router runs one swap at a time). Durations are
+    wall deltas AND tick deltas — ticks are the fake-clock-proof unit."""
+    open_by_version: Dict[int, dict] = {}
+    episodes, opened = [], 0
+    for e in ev:
+        v = e.get("version")
+        if e["kind"] == "swap_start":
+            opened += 1
+            open_by_version[v] = e
+        elif e["kind"] in ("swap_commit", "swap_rollback"):
+            start = open_by_version.pop(v, None)
+            if start is None:
+                continue
+            ep = {"kind": "swap", "version": v,
+                  "outcome": e["kind"].split("_", 1)[1],
+                  "t0": start["t"], "t1": e["t"],
+                  "duration_s": round(e["t"] - start["t"], 6)}
+            if "tick" in e and "tick" in start:
+                ep["ticks"] = int(e["tick"]) - int(start["tick"])
+            if e["kind"] == "swap_rollback":
+                ep["cause"] = e.get("cause", "")
+            episodes.append(ep)
+    return episodes, len(open_by_version)
+
+
+def _quarantine_episodes(ev: List[dict]) -> Tuple[List[dict], int]:
+    """Per-replica ``health_transition`` pairing: entering QUARANTINED
+    opens an episode; returning to HEALTHY closes it (probation rides
+    inside). Durations are deltas of the tracker's own ``at`` clock."""
+    open_by_replica: Dict[int, dict] = {}
+    episodes = []
+    for e in ev:
+        if e["kind"] != "health_transition":
+            continue
+        r = e.get("replica")
+        if e.get("state_to") == "quarantined" and r not in open_by_replica:
+            open_by_replica[r] = e
+        elif e.get("state_to") == "healthy" and r in open_by_replica:
+            start = open_by_replica.pop(r)
+            at0 = start.get("at", start["t"])
+            at1 = e.get("at", e["t"])
+            episodes.append({"kind": "quarantine", "replica": r,
+                             "cause": start.get("cause", ""),
+                             "t0": start["t"], "t1": e["t"],
+                             "duration_s": round(at1 - at0, 6)})
+    return episodes, len(open_by_replica)
+
+
+def _excursion_episodes(ev: List[dict]) -> Tuple[List[dict], int]:
+    """Paired ``slo_excursion`` enter/exit edges per key (the Heartbeat's
+    per-episode dedup); durations are pump-tick deltas."""
+    open_by_key: Dict[str, dict] = {}
+    episodes = []
+    for e in ev:
+        if e["kind"] != "slo_excursion":
+            continue
+        key = e.get("key", "fleet")
+        if e.get("edge") == "enter":
+            open_by_key[key] = e
+        elif e.get("edge") == "exit" and key in open_by_key:
+            start = open_by_key.pop(key)
+            episodes.append({"kind": "slo_excursion", "key": key,
+                             "t0": start["t"], "t1": e["t"],
+                             "ticks": int(e.get("tick", 0))
+                             - int(start.get("tick", 0)),
+                             "worst_ok_frac": start.get("ok_frac")})
+    return episodes, len(open_by_key)
+
+
+def _duration_stats(episodes: List[dict], field: str = "duration_s") -> dict:
+    xs = [float(ep[field]) for ep in episodes if field in ep]
+    if not xs:
+        return {}
+    return {f"{field.rsplit('_', 1)[0]}_p50_s": round(_percentile(xs, 0.50), 6),
+            f"{field.rsplit('_', 1)[0]}_p99_s": round(_percentile(xs, 0.99), 6),
+            f"{field.rsplit('_', 1)[0]}_total_s": round(sum(xs), 6)}
+
+
+def derive_slo_report(entries: List[dict]) -> dict:
+    """The run's SLO story, derived purely from the merged entries: MTTR
+    per recovery episode, swap duration percentiles + canary breaches,
+    quarantine episode count/durations, SLO-excursion episodes, requeue
+    totals, and acceptance-by-version (draft staleness) when the serve
+    summary landed on the plane."""
+    ev = [e for e in entries if e["source"] == "events"]
+    report: dict = {}
+
+    # --- recovery: the controller's own verdicts. The event plane and
+    # controller.jsonl both carry them when both exist — count ONE
+    # source (the plane first), never the union, or MTTR doubles.
+    mttr = [float(e["mttr_s"]) for e in ev
+            if e["kind"] == "controller_recovered" and "mttr_s" in e]
+    if not mttr:
+        mttr = [float(e["mttr_s"]) for e in entries
+                if e["source"] == "controller"
+                and e["kind"] == "controller_recovered" and "mttr_s" in e]
+    run_end = [e for e in ev if e["kind"] == "run_end"]
+    if run_end:
+        last = run_end[-1]
+        report["run_final"] = last.get("final", "unknown")
+        report["restarts"] = int(last.get("restarts", 0))
+        report["causes"] = list(last.get("causes", []))
+        if not mttr:
+            mttr = [float(x) for x in last.get("mttr_s", [])]
+    if mttr:
+        report["mttr_s"] = [round(x, 6) for x in mttr]
+        report["mttr_mean_s"] = round(sum(mttr) / len(mttr), 6)
+
+    swaps, swaps_open = _swap_episodes(ev)
+    if swaps or swaps_open:
+        sw = {"commits": sum(1 for s in swaps if s["outcome"] == "commit"),
+              "rollbacks": sum(1 for s in swaps
+                               if s["outcome"] == "rollback"),
+              "canary_breaches": sum(
+                  1 for s in swaps if s["outcome"] == "rollback"
+                  and str(s.get("cause", "")).startswith("canary")),
+              "open": swaps_open}
+        sw.update(_duration_stats(swaps))
+        report["swap"] = sw
+    draft_swaps = [e for e in ev if e["kind"] == "swap_commit"
+                   and e.get("draft")]
+    if draft_swaps:
+        report.setdefault("swap", {})["draft_commits"] = len(draft_swaps)
+
+    quarantines, q_open = _quarantine_episodes(ev)
+    if quarantines or q_open:
+        q = {"episodes": len(quarantines), "open": q_open}
+        q.update(_duration_stats(quarantines))
+        report["quarantine"] = q
+
+    excursions, x_open = _excursion_episodes(ev)
+    if excursions or x_open:
+        ticks = [ep["ticks"] for ep in excursions]
+        x = {"episodes": len(excursions), "open": x_open}
+        if ticks:
+            x["ticks_p50"] = _percentile(ticks, 0.50)
+            x["ticks_p99"] = _percentile(ticks, 0.99)
+        report["slo_excursions"] = x
+
+    drains = [e for e in ev if e["kind"] == "requeue_drain"]
+    if drains:
+        report["requeue"] = {
+            "drains": len(drains),
+            "requeued": sum(int(d.get("requeued", 0)) for d in drains),
+            "shed": sum(int(d.get("shed", 0)) for d in drains)}
+
+    summaries = [e for e in ev if e["kind"] == "serve_summary"]
+    if summaries and summaries[-1].get("accept_by_version"):
+        report["accept_by_version"] = summaries[-1]["accept_by_version"]
+
+    ckpt_falls = sum(1 for e in ev if e["kind"] in ("ckpt_fallback",
+                                                    "ckpt_resume_degraded"))
+    if ckpt_falls:
+        report["ckpt_degraded_events"] = ckpt_falls
+    return report
+
+
+# ------------------------------------------------------------ chrome trace
+
+_SOURCE_PIDS = {"events": 1, "controller": 2, "heartbeat": 3,
+                "postmortem": 4, "episodes": 5}
+
+
+def write_chrome_trace(path: str, entries: List[dict]) -> int:
+    """A Perfetto-loadable chrome-trace JSON: every entry as an instant
+    event (pid = source, tid = replica when the entry names one) plus
+    complete ("X") slices for the derived swap/quarantine/excursion
+    episodes. Timestamps are microseconds from the earliest entry —
+    byte-identical for the same entries (sorted keys, no wall stamps of
+    its own). Returns the number of trace events written."""
+    t0 = min((e["t"] for e in entries), default=0.0)
+    trace: List[dict] = []
+    for source, pid in sorted(_SOURCE_PIDS.items()):
+        trace.append({"args": {"name": source}, "name": "process_name",
+                      "ph": "M", "pid": pid})
+    for e in entries:
+        args = {k: v for k, v in e.items()
+                if k not in ("t", "source", "kind")}
+        trace.append({"args": args, "name": e["kind"], "ph": "i",
+                      "pid": _SOURCE_PIDS.get(e["source"], 9), "s": "g",
+                      "tid": int(e.get("replica", 0))
+                      if isinstance(e.get("replica"), (int, float)) else 0,
+                      "ts": round((e["t"] - t0) * 1e6, 1)})
+    ev = [e for e in entries if e["source"] == "events"]
+    for episodes in (_swap_episodes(ev)[0], _quarantine_episodes(ev)[0],
+                     _excursion_episodes(ev)[0]):
+        for ep in episodes:
+            args = {k: v for k, v in ep.items()
+                    if k not in ("t0", "t1", "kind")}
+            trace.append({"args": args, "dur": round(
+                              (ep["t1"] - ep["t0"]) * 1e6, 1),
+                          "name": ep["kind"], "ph": "X",
+                          "pid": _SOURCE_PIDS["episodes"],
+                          "tid": int(ep.get("replica", 0)),
+                          "ts": round((ep["t0"] - t0) * 1e6, 1)})
+    atomic_replace(path, json.dumps({"traceEvents": trace},
+                                    sort_keys=True))
+    return len(trace)
+
+
+def build_timeline(logdir: str, *, events_dir: Optional[str] = None,
+                   chrome: str = "") -> dict:
+    """The timeline CLI's one JSON line: source counts, per-kind counts,
+    and the derived SLO report. Degraded inputs (no event plane, no
+    controller log) shrink the report, they never fail it."""
+    entries = collect_entries(logdir, events_dir=events_dir)
+    sources: Dict[str, int] = {}
+    kinds: Dict[str, int] = {}
+    for e in entries:
+        sources[e["source"]] = sources.get(e["source"], 0) + 1
+        kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+    report = {"telemetry": "timeline", "logdir": logdir,
+              "entries": len(entries),
+              "sources": dict(sorted(sources.items())),
+              "kinds": dict(sorted(kinds.items())),
+              "slo": derive_slo_report(entries)}
+    if not entries:
+        report["note"] = ("no timeline sources under the logdir — expected "
+                          "an event plane (EVENTS_MANIFEST.json / "
+                          "events-*.jsonl), controller.jsonl, or "
+                          "telemetry/ liveness files")
+    if chrome:
+        report["chrome_trace"] = chrome
+        report["chrome_trace_events"] = write_chrome_trace(chrome, entries)
+    return report
+
+
+__all__ = ["build_timeline", "collect_entries", "derive_slo_report",
+           "resolve_events_dir", "write_chrome_trace"]

@@ -187,6 +187,15 @@ flags.DEFINE_string("log_sink_dir", "", "serve-traffic log sink (ISSUE "
                     "the 'servelog' stream source for draft distillation "
                     "(docs/DATA.md). Host-side only: zero added device "
                     "readbacks")
+flags.DEFINE_string("event_log_dir", "", "fleet EVENT PLANE (ISSUE 20): "
+                    "append every host-side lifecycle event (health "
+                    "transitions, requeue drains, swap drain/canary/"
+                    "commit/rollback, SLO excursions, sink rotations, "
+                    "control-plane tick-profiler rollups) to CRC-framed "
+                    "size-rotated shards under this dir; `python -m "
+                    "dtf_tpu.telemetry timeline` merges them into one "
+                    "causally-ordered run story (docs/OBSERVABILITY.md "
+                    "§9). Host-side only: zero added device readbacks")
 flags.DEFINE_string("draft_publish_dir", "", "poll this publish dir for "
                     "DISTILLED DRAFT versions (train_gpt --distill_draft "
                     "writes them) and roll DRAFT-ONLY swaps across the "
@@ -396,6 +405,14 @@ def main(argv):
         if FLAGS.trace_out:
             tel.tracer = TraceCollector()
     writer = MetricWriter(None, also_log=False)
+    # the fleet event plane (ISSUE 20): ONE log every serve-side
+    # subsystem writes, built first so the sink's own mount-time
+    # recovery (orphan adoption) is already on the record
+    events = None
+    if FLAGS.event_log_dir:
+        from dtf_tpu.telemetry.events import EventLog
+
+        events = EventLog(FLAGS.event_log_dir)
     # the serve-traffic log sink (ISSUE 19): one sink for the whole fleet
     # (the pump is single-threaded; records carry their replica id) so
     # the shard sequence a mounted 'servelog' source addresses is global
@@ -403,7 +420,7 @@ def main(argv):
     if FLAGS.log_sink_dir:
         from dtf_tpu.serve.logsink import LogSink
 
-        sink = LogSink(FLAGS.log_sink_dir)
+        sink = LogSink(FLAGS.log_sink_dir, events=events)
     try:
         if FLAGS.replicas > 1:
             from dtf_tpu.serve import HealthConfig, Router
@@ -434,7 +451,7 @@ def main(argv):
                 writer=writer, telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
                 health=health, max_queue=FLAGS.max_queue,
                 prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
-                log_sink=sink)
+                log_sink=sink, events=events)
             engines = [s.engine for s in sched.schedulers]
         else:
             engines = [DecodeEngine(
@@ -448,8 +465,18 @@ def main(argv):
                 prefill_chunks_per_tick=FLAGS.prefill_chunks_per_tick,
                 telemetry=tel, ttft_slo_s=FLAGS.ttft_slo,
                 max_queue=FLAGS.max_queue, log_sink=sink)
+            if events is not None:
+                # the fault installer's crash_in_event_rotate branch and
+                # the summary emit read the pump's .events either way
+                sched.events = events
     except ValueError as e:     # n_slots/max_len/prefill_chunk/page flags
         raise app.UsageError(str(e))
+    if events is not None:
+        events.emit("serve_start", replicas=FLAGS.replicas,
+                    version=served_version, step=int(step),
+                    spec_k=engines[-1].spec_k if FLAGS.replicas > 1
+                    else engines[0].spec_k,
+                    prefill_replicas=FLAGS.prefill_replicas)
     if served_version:
         # stamp the published version the fleet was BUILT with, so record
         # stamps / page epochs / the skew tripwire carry the real number
@@ -524,7 +551,7 @@ def main(argv):
         heartbeat = Heartbeat(sched, every_ticks=FLAGS.stats_every,
                               slo_floor=FLAGS.ttft_slo_frac,
                               flight=tel.flight if tel is not None
-                              else None)
+                              else None, events=events)
     hooks = [h for h in
              (heartbeat.maybe_emit if heartbeat is not None else None,
               swap_tick) if h is not None]
@@ -639,6 +666,19 @@ def main(argv):
         out["log_sink"] = sink.stats()
     if FLAGS.draft_publish_dir:
         out["draft_publish_dir"] = FLAGS.draft_publish_dir
+    if events is not None:
+        # the run's closing record: statuses + the per-version acceptance
+        # panel land on the timeline (derive_slo_report's
+        # accept_by_version source), then the open shard commits
+        events.emit("serve_summary", requests=len(rids),
+                    generated_tokens=n_tokens, statuses=statuses,
+                    final_version=out["final_version"],
+                    accept_by_version={str(v): [p, a]
+                                       for v, (p, a) in acc.items()}
+                    if acc else {})
+        events.close()
+        out["event_log_dir"] = FLAGS.event_log_dir
+        out["event_log"] = events.stats()
     if heartbeat is not None:
         # heartbeats + SLO-excursion count + worst compliance fraction:
         # a run that breached and recovered must not look clean

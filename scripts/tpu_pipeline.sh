@@ -41,6 +41,9 @@ python scripts/bench_decode.py
 python scripts/bench_decode.py --sweep-serve
 python scripts/bench_telemetry.py
 python scripts/bench_profile.py
+# control-plane ticks/sec (ISSUE 20): chip-independent, banked per round
+# with a fail-closed regression fence -> CONTROL_PLANE.json
+python scripts/bench_serve_cp.py
 python scripts/bench_cost_table.py
 python bench.py
 python scripts/bench_lm.py --phases-gpt

@@ -102,6 +102,13 @@ flags.DEFINE_string("publish_dir", "", "weight hot-swap publishing "
                     "downtime (docs/RESILIENCE.md §9)")
 flags.DEFINE_integer("publish_every", 100, "with --publish_dir: publish "
                      "a version every N steps (plus once at end of run)")
+flags.DEFINE_string("event_log_dir", "", "fleet EVENT PLANE (ISSUE 20): "
+                    "chief-side lifecycle events (checkpoint saves, "
+                    "degraded restores, published versions, stream "
+                    "reweights/faults) append to CRC-framed shards under "
+                    "this dir; `python -m dtf_tpu.telemetry timeline` "
+                    "merges them with the serve/fault trails into one "
+                    "run story (docs/OBSERVABILITY.md §9)")
 flags.DEFINE_string("stream_spec", "", "streaming data tier (ISSUE 15, "
                     "docs/DATA.md): a JSON mixture spec (inline or a "
                     ".json path) of weighted token sources — "
@@ -502,6 +509,17 @@ def main(argv):
         # change of) exactly this spec
         manifest_cfg[dstream.MANIFEST_KEY] = stream_spec
     save_model_config(ckpt.directory, manifest_cfg)
+    # the fleet event plane (ISSUE 20): chief-only — EventLog is a
+    # single-writer log, and under the fake-hosts harness N workers over
+    # one dir would interleave two generations of shards
+    events = None
+    if FLAGS.event_log_dir and getattr(info, "participates_in_save", True):
+        from dtf_tpu.telemetry.events import EventLog
+
+        events = EventLog(FLAGS.event_log_dir)
+        ckpt.attach_event_log(events)
+        if stream is not None:
+            stream.attach_event_log(events)
     publisher = None
     # only the checkpoint-owning process publishes (the PreemptionHook
     # ckpt=None idiom): under the fake-hosts harness every worker is its
@@ -511,6 +529,8 @@ def main(argv):
         from dtf_tpu.publish import ParamPublisher
 
         publisher = ParamPublisher(FLAGS.publish_dir)
+        if events is not None:
+            publisher.event_log = events
         # the architecture manifest rides next to the publish manifest so
         # a fleet serving ONLY the publish dir still resolves the config
         save_model_config(FLAGS.publish_dir, manifest_cfg)
@@ -563,6 +583,9 @@ def main(argv):
     emit_run_report(tel, info, extra=extra)
     writer.close()
     ckpt.close()
+    if events is not None:
+        events.emit("train_end", step=int(state.step))
+        events.close()
     print(f"done: step={int(state.step)}")
 
 
